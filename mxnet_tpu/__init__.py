@@ -1,0 +1,30 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Usage mirrors the reference's `import mxnet as mx`::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+
+Architecture (see SURVEY.md §7): NDArray/autograd/Symbol/Module/Gluon/KVStore
+API capabilities of the reference on a JAX/XLA execution core — XLA subsumes
+the reference's threaded dependency engine, memory planner, kernel library
+and NCCL/ps-lite comm stack; Pallas covers custom kernels; pjit/shard_map
+over a device Mesh covers every distributed mode.
+"""
+from .base import MXNetError, __version__
+from .context import Context, cpu, tpu, gpu, num_gpus, num_tpus, \
+    current_context
+from . import base
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import profiler
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
+           "random", "MXNetError"]

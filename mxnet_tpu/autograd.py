@@ -1,0 +1,324 @@
+"""Imperative autograd: record/pause scopes + tape backward.
+
+Reference: python/mxnet/autograd.py and src/imperative/imperative.cc
+(RecordOp :183, Backward :270). The reference builds an NNVM gradient graph
+and replays it through the engine; here each recorded op carries a jax.vjp
+closure (an XLA-compiled pullback), and backward() walks the tape in
+reverse topological order accumulating cotangents. Gradients of jitted
+graphs (CachedOp / Executor) don't use this tape at all — they are computed
+by jax.grad over the whole traced function, which is the TPU-idiomatic path.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _st().training
+    _state.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._record = is_record
+        self._train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._record is not None:
+            st.recording = self._record
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _state.recording, _state.training = self._prev
+        return False
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for backward."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class _TapeNode:
+    __slots__ = ("op", "inputs", "vjp_fn", "n_raw", "visible", "out_avals")
+
+    def __init__(self, op, inputs, vjp_fn, n_raw, visible, out_avals=()):
+        self.op = op
+        self.inputs = inputs      # list of NDArray (strong refs)
+        self.vjp_fn = vjp_fn
+        self.n_raw = n_raw        # raw output arity (incl. hidden aux)
+        self.visible = visible
+        # (shape, dtype) per raw output — needed to zero-fill cotangent
+        # slots of unused outputs (vjp wants the full output pytree)
+        self.out_avals = out_avals
+
+
+def _record(op, inputs, outputs, raw, vjp_fn):
+    """Called by ndarray.invoke under record scope."""
+    node = _TapeNode(op, list(inputs), vjp_fn, len(raw), len(outputs),
+                     out_avals=[(r.shape, r.dtype) for r in raw])
+    for i, out in enumerate(outputs):
+        out._tape_node = node
+        out._tape_index = i
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: autograd.py:197)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    if gradients is None:
+        gradients = [None] * len(variables)
+    if not isinstance(gradients, (list, tuple)):
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.attach_grad(grad_req=req)
+        if g is not None:
+            v._grad._data = g._data
+
+
+def _is_float0(x):
+    return x.dtype == jax.dtypes.float0
+
+
+def _walk(heads, head_grads, retain_graph, collect_for=None):
+    """Reverse-topological cotangent propagation.
+
+    collect_for: optional list of NDArrays — return their grads instead of
+    (in addition to) writing into attached .grad buffers.
+    """
+    from .ndarray.ndarray import NDArray
+
+    # seed cotangents per node
+    node_cots = {}   # node -> list of cotangent arrays per raw output
+    leaf_grads = {}  # id(ndarray) -> (ndarray, accumulated jax array)
+
+    def seed(nd, g):
+        node = nd._tape_node
+        if node is None:
+            # head is a leaf: its own grad is the seed
+            if nd._grad is not None or collect_for is not None:
+                acc = leaf_grads.get(id(nd))
+                leaf_grads[id(nd)] = (nd, g if acc is None else acc[1] + g)
+            return
+        cots = node_cots.setdefault(node, [None] * node.n_raw)
+        idx = nd._tape_index
+        cots[idx] = g if cots[idx] is None else cots[idx] + g
+
+    for nd, g in zip(heads, head_grads):
+        if nd._tape_node is None and nd._grad is None and collect_for is None:
+            raise MXNetError(
+                "cannot differentiate: output is not in the recorded graph "
+                "(was it computed under autograd.record()?)")
+        seed(nd, g)
+
+    # topo order over nodes reachable from heads
+    order = []
+    seen = set()
+
+    def dfs(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs:
+            if isinstance(inp, NDArray):
+                dfs(inp._tape_node)
+        order.append(node)
+
+    for nd in heads:
+        dfs(nd._tape_node)
+
+    for node in reversed(order):
+        cots = node_cots.get(node)
+        if cots is None:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "backward: graph was already freed "
+                "(pass retain_graph=True to backward() to reuse it)")
+        # fill missing output cotangents with zeros: vjp needs all of them
+        filled = [c if c is not None else jnp.zeros(sh, dt)
+                  for c, (sh, dt) in zip(cots, node.out_avals)]
+        in_cots = node.vjp_fn(tuple(filled))
+        offset = 1 if node.op.needs_rng else 0
+        for j, inp in enumerate(node.inputs):
+            g = in_cots[j + offset]
+            if g is None or _is_float0(g):
+                continue
+            if not isinstance(inp, NDArray):
+                continue
+            if inp._tape_node is not None:
+                cc = node_cots.setdefault(inp._tape_node,
+                                          [None] * inp._tape_node.n_raw)
+                idx = inp._tape_index
+                cc[idx] = g if cc[idx] is None else cc[idx] + g
+            if inp._grad is not None or collect_for is not None:
+                acc = leaf_grads.get(id(inp))
+                leaf_grads[id(inp)] = (inp, g if acc is None else acc[1] + g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    # write into .grad buffers
+    for _, (nd, g) in leaf_grads.items():
+        if nd._grad is not None:
+            if nd._grad_req == "add":
+                nd._grad._data = nd._grad._data + g
+            elif nd._grad_req != "null":
+                nd._grad._data = g
+
+    if collect_for is not None:
+        out = []
+        for v in collect_for:
+            ent = leaf_grads.get(id(v))
+            out.append(None if ent is None else ent[1])
+        return out
+    return None
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. all marked variables
+    (reference: autograd.py:243)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [jnp.ones_like(h._data) for h in heads]
+    else:
+        if not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+        head_grads = [jnp.ones_like(h._data) if g is None else g._data
+                      for h, g in zip(heads, head_grads)]
+    _walk(heads, head_grads, retain_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return grads of heads w.r.t. variables (reference: autograd.py:270).
+    create_graph (higher-order) is not supported yet."""
+    from .ndarray.ndarray import NDArray
+    if create_graph:
+        raise MXNetError("autograd.grad: create_graph=True not supported yet")
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    if head_grads is None:
+        head_grads = [jnp.ones_like(h._data) for h in heads]
+    else:
+        head_grads = [g._data for g in head_grads]
+    gs = _walk(heads, head_grads, retain_graph, collect_for=variables)
+    out = []
+    for v, g in zip(variables, gs):
+        if g is None:
+            raise MXNetError("autograd.grad: a variable is unreachable "
+                             "from the heads")
+        out.append(NDArray(g, v._ctx))
+    return out
+
+
+class Function:
+    """Custom differentiable function (reference: autograd.py:363).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads), operating on NDArrays with .asjax()."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *ograds):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                with pause():
+                    grads = func.backward(
+                        *[NDArray(c) for c in cots])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return tuple(g._data if g is not None else None
+                             for g in grads)
+
+            class _FakeOp:
+                needs_rng = False
+                name = "custom_function"
+            node = _TapeNode(_FakeOp(), list(inputs), vjp_fn, len(outs),
+                             len(outs),
+                             out_avals=[(o.shape, o.dtype) for o in outs])
+            for i, o in enumerate(outs):
+                o._tape_node = node
+                o._tape_index = i
+        return outs[0] if single else outs

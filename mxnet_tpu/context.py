@@ -1,0 +1,123 @@
+"""Device contexts.
+
+Reference: include/mxnet/base.h:133 (Context) and python/mxnet/context.py.
+TPU-native: a Context names a jax.Device. `tpu()` is the first-class
+accelerator; `gpu()` is accepted as an alias for accelerator code written
+against the reference API. The with-statement scoping semantics
+(`with mx.Context(...)`) are preserved.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+_local = threading.local()
+
+
+class Context:
+    """A device context. devtype in {'cpu', 'tpu', 'gpu'} ('gpu' aliases 'tpu'
+    when no GPU backend exists, which is the normal case here)."""
+
+    devtype2mask = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type, self.device_id = device_type.device_type, device_type.device_id
+        else:
+            if device_type not in self.devtype2mask:
+                raise MXNetError("unknown device type %r" % (device_type,))
+            self.device_type = device_type
+            self.device_id = int(device_id)
+        self._old_ctx = None
+
+    # -- jax mapping ------------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = _devices_for(self.device_type)
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                "context %s: only %d %s device(s) available"
+                % (self, len(devs), self.device_type))
+        return devs[self.device_id]
+
+    def is_accelerator(self) -> bool:
+        return self.device_type in ("tpu", "gpu")
+
+    # -- identity ---------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- scoping ----------------------------------------------------------
+    def __enter__(self):
+        self._old_ctx = getattr(_local, "default_ctx", None)
+        _local.default_ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.default_ctx = self._old_ctx
+        return False
+
+    @classmethod
+    def default_ctx(cls):
+        ctx = getattr(_local, "default_ctx", None)
+        if ctx is None:
+            ctx = cls("cpu", 0)
+            _local.default_ctx = ctx
+        return ctx
+
+
+def _devices_for(device_type):
+    backend = jax.default_backend()
+    if device_type == "cpu":
+        if backend == "cpu":
+            return jax.devices()
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return jax.devices()
+    # accelerator ('tpu'/'gpu'): whatever the default accelerator backend is.
+    # Under the CPU test mesh there is no accelerator; fall back to host
+    # devices so tests can run tpu-targeted code paths unchanged.
+    if backend == "cpu":
+        return jax.devices()
+    return jax.devices()
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Alias for accelerator context, for reference-API compatibility."""
+    return Context("gpu", device_id)
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def num_tpus():
+    if jax.default_backend() == "cpu":
+        return 0
+    return len(jax.devices())
+
+
+def current_context():
+    return Context.default_ctx()

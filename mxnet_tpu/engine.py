@@ -1,0 +1,72 @@
+"""Execution engine facade.
+
+Reference: src/engine/ — the threaded dependency engine (ThreadedVar /
+ThreadedOpr read-write dependency tracking, per-device worker pools,
+NaiveEngine debug mode selected by MXNET_ENGINE_TYPE).
+
+TPU-native: the scheduler IS the XLA/PJRT runtime. JAX dispatches ops
+asynchronously and orders them by data dependence (SSA values = the
+reference's versioned variables); there is nothing to re-implement, so this
+module is a thin control surface kept for API/debug parity:
+
+- `set_bulk_size` (reference: engine.set_bulk_size / MXNET_ENGINE_BULK_SIZE)
+  is a no-op knob: op "bulking" is what jax.jit does, always.
+- NaiveEngine's serial-oracle role (deterministic debugging of async
+  failures, threaded_engine.h:383) maps to `deterministic()`: disables
+  donation/async by syncing after each op, plus jax's own
+  `jax_debug_nans`-style checks can be toggled by the caller.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .base import getenv
+
+_bulk = threading.local()
+_MODE = {"mode": getenv("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice")}
+
+
+def set_bulk_size(size):
+    """Kept for parity (reference: python/mxnet/engine.py). Returns the
+    previous value. Bulking is subsumed by jit; the knob only tracks state."""
+    prev = getattr(_bulk, "size", 15)
+    _bulk.size = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def engine_type():
+    return _MODE["mode"]
+
+
+@contextlib.contextmanager
+def deterministic():
+    """Serial oracle mode (the reference's NaiveEngine): block after every
+    eager op so failures surface at their call site, not at a later sync
+    point. Usage: with engine.deterministic(): ..."""
+    from .ndarray import ndarray as _nd_mod
+    prev = _MODE["mode"]
+    _MODE["mode"] = "NaiveEngine"
+    orig_invoke = _nd_mod.invoke
+
+    def sync_invoke(op, inputs, params, name=None):
+        outs = orig_invoke(op, inputs, params, name)
+        for o in outs:
+            o.wait_to_read()
+        return outs
+
+    _nd_mod.invoke = sync_invoke
+    try:
+        yield
+    finally:
+        _nd_mod.invoke = orig_invoke
+        _MODE["mode"] = prev

@@ -1,0 +1,51 @@
+"""The `nd` namespace: NDArray + one function per registered operator.
+
+Reference: python/mxnet/ndarray/__init__.py (+ the ctypes codegen in
+register.py / _init_op_module in base.py:561).
+"""
+import sys as _sys
+import types as _types
+
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty,
+                      arange, zeros_like, ones_like, concatenate, moveaxis,
+                      waitall, load, save, _as_nd)
+from . import sparse
+from .sparse import RowSparseNDArray, CSRNDArray
+from .register import populate as _populate
+
+_populate(globals())
+
+# nd.random.* namespace (reference: ndarray/random.py)
+random = _types.ModuleType(__name__ + ".random")
+_g = globals()
+for _name in ("uniform", "normal", "randint"):
+    random.__dict__[_name] = _g["_random_%s" % _name]
+for _name in ("gamma", "exponential", "poisson", "negative_binomial",
+              "generalized_negative_binomial"):
+    random.__dict__[_name] = _g["_random_%s" % _name]
+random.__dict__["multinomial"] = _g["_sample_multinomial"]
+random.__dict__["shuffle"] = _g["_shuffle"]
+random.__dict__["seed"] = __import__(
+    "mxnet_tpu.random", fromlist=["seed"]).seed
+_sys.modules[__name__ + ".random"] = random
+
+# nd.linalg.* namespace (reference: ndarray/linalg.py)
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _name in ("gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+              "sumlogdiag", "syevd", "gelqf"):
+    _key = "_linalg_%s" % _name
+    if _key in _g:
+        linalg.__dict__[_name] = _g[_key]
+_sys.modules[__name__ + ".linalg"] = linalg
+
+# nd.contrib.* namespace — populated as contrib ops are registered
+contrib = _types.ModuleType(__name__ + ".contrib")
+_sys.modules[__name__ + ".contrib"] = contrib
+
+
+def _refresh_namespaces():
+    """Re-run codegen after late op registrations (contrib ops etc.)."""
+    _populate(_g)
+    for _name in list(_g):
+        if _name.startswith("_contrib_"):
+            contrib.__dict__[_name[len("_contrib_"):]] = _g[_name]

@@ -1,0 +1,73 @@
+"""Frontend codegen: turn every registered op into an `nd.<name>` function.
+
+Reference: python/mxnet/ndarray/register.py:29-168 — there, ctypes reads the
+C op registry and exec's generated Python. Here the registry is in-process,
+so the "codegen" is a closure per op with the same calling convention:
+positional NDArray inputs (or keyword inputs by the op's input names),
+keyword params, multi-output ops return a list.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke, _as_nd
+
+
+def _make_op_func(op):
+    def fn(*args, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (np.ndarray, list)) and (
+                    inputs or not op.params):
+                inputs.append(_as_nd(a))
+            elif isinstance(a, (np.ndarray, list)):
+                inputs.append(_as_nd(a))
+            else:
+                raise MXNetError(
+                    "op %s: positional arguments must be NDArrays, got %r "
+                    "(pass params as keywords)" % (op.name, type(a)))
+        named = {}
+        params = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray) or (k in op.input_names and v is not None
+                                          and not isinstance(v, (int, float, str, bool, tuple))):
+                named[k] = _as_nd(v) if not isinstance(v, NDArray) else v
+            else:
+                params[k] = v
+        if named:
+            # place keyword inputs at their positional slots after the
+            # already-given positional inputs
+            order = [n for n in op.input_names if n in named]
+            # unknown names (e.g. variadic inputs) appended in kwargs order
+            order += [n for n in named if n not in op.input_names]
+            for n in order:
+                inputs.append(named[n])
+        params.pop("name", None)
+        out = params.pop("out", None)
+        outs = invoke(op, inputs, params)
+        if out is not None:
+            out._data = outs[0]._data
+            return out
+        return outs[0] if len(outs) == 1 else outs
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def populate(namespace_dict, symbolic=False):
+    """Install one function per registered op into a module namespace."""
+    done = set()
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        if symbolic:
+            from ..symbol.register import make_symbol_func
+            namespace_dict.setdefault(name, make_symbol_func(op, name))
+        else:
+            namespace_dict.setdefault(name, _make_op_func(op))
+        done.add(name)
+    return done

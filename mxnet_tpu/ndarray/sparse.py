@@ -1,0 +1,261 @@
+"""Sparse NDArray storage types: row_sparse and CSR.
+
+Reference: include/mxnet/ndarray.h:61-65 (storage types),
+python/mxnet/ndarray/sparse.py (RowSparseNDArray, CSRNDArray),
+src/operator/tensor/cast_storage-inl.h, dot-inl.h (sparse dot).
+
+TPU-native note: XLA is a static-shape world, so sparse arrays here carry a
+FIXED-capacity index/value buffer (padded with sentinel rows). That is the
+standard TPU embedding-gradient design: a row_sparse gradient of capacity K
+is (indices[K], values[K, ...]) where unused slots point at row 0 with zero
+values — scatter-add folds them away. cast_storage to dense is exact;
+dense→sparse uses a capacity bound (default: full rows, i.e. lossless).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_from_name
+from ..context import current_context
+from .ndarray import NDArray, _as_nd, array
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows-of-a-dense-tensor sparse format: (indices [K], values [K, ...]).
+
+    Invariant: dense.shape = (num_rows,) + values.shape[1:]; row indices may
+    contain padding slots marked by index == num_rows (scattered nowhere).
+    """
+    __slots__ = ("_indices", "_values", "_dense_shape")
+
+    def __init__(self, values, indices, shape, ctx=None):
+        values = _as_nd(values)
+        indices = _as_nd(indices, dtype="int32") if not isinstance(indices, NDArray) else indices
+        self._values = values
+        self._indices = indices
+        self._dense_shape = tuple(shape)
+        super().__init__(values._data, ctx, _stype="row_sparse")
+
+    @property
+    def shape(self):
+        return self._dense_shape
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def data(self):
+        return self._values
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def asnumpy(self):
+        return np.asarray(self._to_dense_jax())
+
+    def _to_dense_jax(self):
+        n = self._dense_shape[0]
+        idx = self._indices._data.astype(jnp.int32)
+        dense = jnp.zeros(self._dense_shape, self._values.dtype)
+        # padding rows carry idx == n; drop them via clip + zero mask
+        valid = (idx < n)[:, None] if self._values.ndim > 1 else (idx < n)
+        vals = jnp.where(valid, self._values._data, 0)
+        return dense.at[jnp.clip(idx, 0, n - 1)].add(vals)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def todense(self):
+        return NDArray(self._to_dense_jax(), self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
+            other._data = self._to_dense_jax()
+            return other
+        return super().copyto(other)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(str(s) for s in self.shape), self.context)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: (data, indices, indptr)."""
+    __slots__ = ("_values", "_indices", "_indptr", "_dense_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._values = _as_nd(data)
+        self._indices = indices if isinstance(indices, NDArray) else _as_nd(indices, dtype="int32")
+        self._indptr = indptr if isinstance(indptr, NDArray) else _as_nd(indptr, dtype="int32")
+        self._dense_shape = tuple(shape)
+        super().__init__(self._values._data, ctx, _stype="csr")
+
+    @property
+    def shape(self):
+        return self._dense_shape
+
+    @property
+    def data(self):
+        return self._values
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def _to_dense_jax(self):
+        m, n = self._dense_shape
+        nnz = self._values.size
+        indptr = self._indptr._data.astype(jnp.int32)
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        cols = self._indices._data.astype(jnp.int32)
+        dense = jnp.zeros((m, n), self._values.dtype)
+        return dense.at[rows, cols].add(self._values._data)
+
+    def asnumpy(self):
+        return np.asarray(self._to_dense_jax())
+
+    def todense(self):
+        return NDArray(self._to_dense_jax(), self._ctx)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(str(s) for s in self.shape), self.context)
+
+
+# ---------------------------------------------------------------------------
+# creation / conversion
+# ---------------------------------------------------------------------------
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        return RowSparseNDArray(_as_nd(values, dtype=dtype), _as_nd(indices),
+                                shape, ctx=ctx)
+    dense = _as_nd(arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_as_nd(data, dtype=dtype), _as_nd(indices),
+                          _as_nd(indptr), shape, ctx=ctx)
+    dense = _as_nd(arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_from_name(dtype or "float32")
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dt),
+            jnp.zeros((0,), jnp.int32), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape,
+                          ctx=ctx)
+    from . import ndarray as _nd
+    return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    """dense <-> row_sparse <-> csr conversion (reference:
+    cast_storage-inl.h). dense->sparse is data-dependent, so it runs on
+    host (eager only) — inside jit, keep arrays dense."""
+    if arr.stype == stype:
+        return arr
+    if stype == "default":
+        if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+            return arr.todense()
+        return arr
+    dense = arr.asnumpy() if not isinstance(arr, (RowSparseNDArray, CSRNDArray)) \
+        else arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                  axis=1))[0]
+        return RowSparseNDArray(dense[nz_rows], nz_rows.astype(np.int32),
+                                dense.shape, ctx=arr._ctx)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(dense.shape[0]):
+            cols = np.where(dense[r] != 0)[0]
+            indices.extend(cols.tolist())
+            data.extend(dense[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(np.array(data, dense.dtype),
+                          np.array(indices, np.int32),
+                          np.array(indptr, np.int32), dense.shape,
+                          ctx=arr._ctx)
+    raise MXNetError("cast_storage: unknown stype %r" % stype)
+
+
+def retain(arr, indices):
+    """Keep only the given rows of a row_sparse array (reference:
+    sparse_retain op)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain: row_sparse input required")
+    want = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
+        else jnp.asarray(indices, jnp.int32)
+    have = arr._indices._data.astype(jnp.int32)
+    # positions of wanted rows in the stored set (host-side, eager op)
+    have_np = np.asarray(have)
+    want_np = np.asarray(want)
+    pos = {int(r): i for i, r in enumerate(have_np)}
+    sel = [pos[int(r)] for r in want_np if int(r) in pos]
+    keep_rows = np.array([int(r) for r in want_np if int(r) in pos], np.int32)
+    vals = np.asarray(arr._values._data)[sel]
+    return RowSparseNDArray(vals, keep_rows, arr.shape, ctx=arr._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference: tensor/dot-inl.h): csr × dense and
+    csr^T × dense — the wide-and-deep / linear-model hot path."""
+    if isinstance(lhs, CSRNDArray):
+        dense = lhs._to_dense_jax()
+        if transpose_a:
+            dense = dense.T
+        out = jnp.matmul(dense, rhs._data.T if transpose_b else rhs._data)
+        return NDArray(out, rhs._ctx)
+    if isinstance(lhs, RowSparseNDArray):
+        dense = lhs._to_dense_jax()
+        if transpose_a:
+            dense = dense.T
+        return NDArray(jnp.matmul(dense, rhs._data), rhs._ctx)
+    raise MXNetError("sparse.dot: unsupported operand types")
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    return lhs + rhs

@@ -1,0 +1,8 @@
+"""Operator library: importing this package registers all ops."""
+from . import registry
+from . import math        # noqa: F401
+from . import tensor      # noqa: F401
+from . import nn          # noqa: F401
+from . import random_ops  # noqa: F401
+from . import init_ops    # noqa: F401
+from .registry import get, exists, list_ops, register, Op  # noqa: F401

@@ -1,0 +1,39 @@
+"""Source/init operators (reference: src/operator/tensor/init_op.cc).
+
+These take no array inputs — shape/dtype are params — so in symbolic graphs
+they are constant-foldable by XLA."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_from_name
+from .registry import register
+
+
+@register("_zeros", aliases=("zeros_op",))
+def _zeros(*, shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(tuple(shape), dtype_from_name(dtype or "float32"))
+
+
+@register("_ones", aliases=("ones_op",))
+def _ones(*, shape=(), dtype="float32", ctx=None):
+    return jnp.ones(tuple(shape), dtype_from_name(dtype or "float32"))
+
+
+@register("_full")
+def _full(*, shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(tuple(shape), value, dtype_from_name(dtype or "float32"))
+
+
+@register("_arange")
+def _arange(*, start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            dtype="float32", ctx=None):
+    arr = jnp.arange(start, stop, step, dtype_from_name(dtype or "float32"))
+    if repeat != 1:
+        arr = jnp.repeat(arr, repeat)
+    return arr
+
+
+@register("_eye", aliases=("eye",))
+def _eye(*, N, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(N, M or None, k, dtype=dtype_from_name(dtype or "float32"))

@@ -1,0 +1,277 @@
+"""Elementwise, scalar, broadcast and reduction operators.
+
+Reference surface: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_op*.cc, elemwise_binary_broadcast_op*.cc,
+elemwise_binary_scalar_op*.cc, broadcast_reduce_op_value.cc, mshadow_op.h.
+
+All ops are pure jnp functions; XLA fuses chains of them into the
+surrounding matmul/conv (the reference needed hand-written mshadow kernel
+composition + the engine's bulking for the same effect).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
+    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc, "fix": jnp.fix,
+    "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "log1p": jnp.log1p, "expm1": jnp.expm1, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "square": jnp.square,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "rsqrt": lax.rsqrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(lambda x, _fn=_fn: _fn(x))
+
+alias("negative", "_np_negative")
+alias("reciprocal", "_rdiv_int")  # internal
+
+
+@register("clip")
+def _clip(x, *, a_min, a_max):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(x):
+    return lax.stop_gradient(x)
+
+
+@register("identity", aliases=("_copy",))
+def _identity(x):
+    return x
+
+
+@register("Cast", aliases=("cast",))
+def _cast(x, *, dtype):
+    from ..base import dtype_from_name
+    return x.astype(dtype_from_name(dtype))
+
+
+@register("zeros_like")
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("shape_array")
+def _shape_array(x):
+    return jnp.array(x.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array")
+def _size_array(x):
+    return jnp.array([x.size], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (same-shape) and broadcast variants
+# ---------------------------------------------------------------------------
+
+def _logical(fn):
+    def wrapped(a, b):
+        return fn(a != 0, b != 0).astype(a.dtype)
+    return wrapped
+
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b).astype(a.dtype),
+    "not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "greater": lambda a, b: (a > b).astype(a.dtype),
+    "greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "lesser": lambda a, b: (a < b).astype(a.dtype),
+    "lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "logical_and": _logical(jnp.logical_and),
+    "logical_or": _logical(jnp.logical_or),
+    "logical_xor": _logical(jnp.logical_xor),
+}
+
+for _name, _fn in _BINARY.items():
+    register("broadcast_%s" % _name)(lambda a, b, _fn=_fn: _fn(a, b))
+
+# elemwise_* are the strict same-shape forms; on XLA the same kernel.
+alias("broadcast_add", "elemwise_add", "_plus", "_add")
+alias("broadcast_sub", "elemwise_sub", "_minus", "_sub")
+alias("broadcast_mul", "elemwise_mul", "_mul")
+alias("broadcast_div", "elemwise_div", "_div")
+alias("broadcast_mod", "_mod")
+alias("broadcast_power", "_power", "_Power")
+alias("broadcast_maximum", "_maximum", "_Maximum")
+alias("broadcast_minimum", "_minimum", "_Minimum")
+alias("broadcast_hypot", "_hypot")
+alias("broadcast_equal", "_equal")
+alias("broadcast_not_equal", "_not_equal")
+alias("broadcast_greater", "_greater")
+alias("broadcast_greater_equal", "_greater_equal")
+alias("broadcast_lesser", "_lesser")
+alias("broadcast_lesser_equal", "_lesser_equal")
+
+
+# scalar forms (reference: elemwise_binary_scalar_op_basic.cc). The scalar is
+# a static param, letting XLA constant-fold it.
+
+def _reg_scalar(name, fn, rfn=None):
+    register("_%s_scalar" % name)(lambda x, *, scalar, _fn=fn: _fn(x, scalar))
+    if rfn is not None:
+        register("_r%s_scalar" % name)(lambda x, *, scalar, _fn=rfn: _fn(x, scalar))
+
+
+_reg_scalar("plus", jnp.add)
+_reg_scalar("minus", jnp.subtract, lambda x, s: s - x)
+_reg_scalar("mul", jnp.multiply)
+_reg_scalar("div", jnp.divide, lambda x, s: s / x)
+_reg_scalar("mod", jnp.mod, lambda x, s: jnp.mod(s, x))
+_reg_scalar("power", jnp.power, lambda x, s: jnp.power(s, x))
+_reg_scalar("maximum", jnp.maximum)
+_reg_scalar("minimum", jnp.minimum)
+_reg_scalar("hypot", jnp.hypot)
+_reg_scalar("equal", lambda x, s: (x == s).astype(x.dtype))
+_reg_scalar("not_equal", lambda x, s: (x != s).astype(x.dtype))
+_reg_scalar("greater", lambda x, s: (x > s).astype(x.dtype))
+_reg_scalar("greater_equal", lambda x, s: (x >= s).astype(x.dtype))
+_reg_scalar("lesser", lambda x, s: (x < s).astype(x.dtype))
+_reg_scalar("lesser_equal", lambda x, s: (x <= s).astype(x.dtype))
+alias("_plus_scalar", "_PlusScalar")
+alias("_minus_scalar", "_MinusScalar")
+alias("_mul_scalar", "_MulScalar")
+alias("_div_scalar", "_DivScalar")
+
+
+@register("smooth_l1")
+def _smooth_l1(x, *, scalar=1.0):
+    s2 = scalar * scalar
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+def _reg_reduce(name, fn, exclude_ok=True):
+    def op(x, *, axis=None, keepdims=False, exclude=False, _fn=fn):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            ax = tuple(i for i in range(x.ndim) if i not in
+                       tuple(a % x.ndim for a in ax))
+        return _fn(x, axis=ax, keepdims=keepdims)
+    register(name)(op)
+
+
+_reg_reduce("sum", jnp.sum)
+_reg_reduce("mean", jnp.mean)
+_reg_reduce("prod", jnp.prod)
+_reg_reduce("nansum", jnp.nansum)
+_reg_reduce("nanprod", jnp.nanprod)
+_reg_reduce("max", jnp.max)
+_reg_reduce("min", jnp.min)
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm")
+def _norm(x, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def _argmax(x, *, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def _argmin(x, *, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, *, shape):
+    # mxnet semantics: 0 in target shape means keep the source dim
+    shape = tuple(int(s) if int(s) != 0 else int(x.shape[i])
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, *, axis, size):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("broadcast_like")
+def _broadcast_like(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# cumulative
+@register("cumsum")
+def _cumsum(x, *, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register("logsumexp")
+def _logsumexp(x, *, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdims)

@@ -1,0 +1,685 @@
+"""Neural-network operators.
+
+Reference surface: src/operator/nn/* (convolution, fully_connected, pooling,
+batch_norm, layer_norm, dropout, softmax, activation, lrn, upsampling),
+src/operator/softmax_output.cc, src/operator/rnn*.{h,cc}, regression ops.
+
+TPU-native notes:
+- conv/FC lower to lax.conv_general_dilated / dot_general → the MXU. The
+  reference's cuDNN algo selection, im2col and autotune have no equivalent
+  here — XLA picks the tiling.
+- fused RNN (reference rnn-inl.h: whole multi-layer sequence as ONE op, via
+  cuDNN) maps to lax.scan over time inside one compiled computation, which
+  is exactly the same "one kernel launch per sequence" property.
+- training/eval mode is a trace-time static (`_mode`), mirroring how the
+  reference's CachedOp keeps separate train/predict graphs.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, tuple_param, dtype_from_name
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+
+
+@register("Activation")
+def _activation(data, *, act_type="relu"):
+    x = data
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x)
+    if act_type == "silu" or act_type == "swish":
+        return jax.nn.silu(x)
+    raise MXNetError("Activation: unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", needs_rng=True, takes_mode=True)
+def _leaky_relu(key, data, *rest, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, _mode="predict"):
+    x = data
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        a, sc = 1.6732632423543772, 1.0507009873554805
+        return sc * jnp.where(x >= 0, x, a * (jnp.exp(x) - 1))
+    if act_type == "prelu":
+        gamma = rest[0]
+        shape = [1] * x.ndim
+        if gamma.size > 1 and x.ndim > 1:
+            shape[1] = gamma.size
+        return jnp.where(x >= 0, x, gamma.reshape(shape) * x)
+    if act_type == "rrelu":
+        if _mode == "train":
+            s = jax.random.uniform(key, x.shape, dtype=x.dtype,
+                                   minval=lower_bound, maxval=upper_bound)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x >= 0, x, s * x)
+    raise MXNetError("LeakyReLU: unknown act_type %r" % act_type)
+
+
+@register("softmax")
+def _softmax(data, *, axis=-1, temperature=None):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(data, *, axis=-1, temperature=None):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def _softmin(data, *, axis=-1, temperature=None):
+    x = data
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, *, mode="instance"):
+    x = data
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# loss-head ops (reference: softmax_output.cc, regression_output.cc).
+# These have custom gradients: as graph heads they seed their own gradient
+# (out_grad is ignored), matching the reference's training semantics.
+# ---------------------------------------------------------------------------
+
+
+def _softmax_output_grad(y, label, grad_scale, ignore_label, use_ignore,
+                         normalization):
+    n_class = y.shape[-1]
+    lbl = label.astype(jnp.int32)
+    one_hot = jax.nn.one_hot(lbl, n_class, dtype=y.dtype)
+    grad = y - one_hot
+    valid = jnp.ones(lbl.shape, dtype=y.dtype)
+    if use_ignore:
+        valid = (lbl != int(ignore_label)).astype(y.dtype)
+        grad = grad * valid[..., None]
+    if normalization == "batch":
+        grad = grad / y.shape[0]
+    elif normalization == "valid":
+        grad = grad / jnp.maximum(valid.sum(), 1.0)
+    return grad * grad_scale
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        normalization):
+    y = jax.nn.softmax(data, axis=-1)
+    return y, (y, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, normalization,
+                        res, g):
+    y, label = res
+    # loss head: ignore incoming gradient (reference softmax_output semantics)
+    grad = _softmax_output_grad(y, label, grad_scale, ignore_label,
+                                use_ignore, normalization)
+    return grad, None
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                    use_ignore=False, multi_output=False,
+                    preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0):
+    """Softmax forward; backward = (softmax - one_hot(label)) * grad_scale.
+    multi_output: data (N, C, d...) softmaxed over C per spatial position."""
+    if multi_output and data.ndim > 2:
+        d = jnp.moveaxis(data, 1, -1)  # (N, d..., C)
+        y = _softmax_output_core(d, label, grad_scale, ignore_label,
+                                 use_ignore, normalization)
+        return jnp.moveaxis(y, -1, 1)
+    if data.ndim > 2 and not preserve_shape:
+        flat = data.reshape(data.shape[0], -1)
+        y = _softmax_output_core(flat, label, grad_scale, ignore_label,
+                                 use_ignore, normalization)
+        return y.reshape(data.shape)
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                use_ignore, normalization)
+
+
+def _make_regression(name, grad_fn, fwd_fn=None):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd_fn(data) if fwd_fn else data
+
+    def fwd(data, label, grad_scale):
+        y = fwd_fn(data) if fwd_fn else data
+        return y, (y, label)
+
+    def bwd(grad_scale, res, g):
+        y, label = res
+        return (grad_fn(y, label) * grad_scale
+                / max(1, int(np.prod(y.shape[1:]))), None)
+
+    core.defvjp(fwd, bwd)
+
+    @register(name)
+    def op(data, label, *, grad_scale=1.0):
+        return core(data, label.reshape(data.shape), grad_scale)
+    return op
+
+
+_make_regression("LinearRegressionOutput", lambda y, l: (y - l))
+_make_regression("MAERegressionOutput", lambda y, l: jnp.sign(y - l))
+_make_regression("LogisticRegressionOutput", lambda y, l: (y - l),
+                 fwd_fn=jax.nn.sigmoid)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _make_loss_core(x, grad_scale):
+    return x
+
+
+def _make_loss_fwd(x, grad_scale):
+    return x, (x.shape, x.dtype)
+
+
+def _make_loss_bwd(grad_scale, res, g):
+    shape, dtype = res
+    return (jnp.full(shape, grad_scale, dtype=dtype),)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def _make_loss(x, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    scale = grad_scale
+    if normalization == "batch":
+        scale = grad_scale / x.shape[0]
+    return _make_loss_core(x, scale)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected / Convolution / Deconvolution / Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected")
+def _fully_connected(data, weight, *rest, num_hidden, no_bias=False, flatten=True):
+    """y = x @ W^T + b (reference: nn/fully_connected.cc). weight is
+    (num_hidden, in_units) like the reference."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
+    if not no_bias:
+        y = y + rest[0]
+    return y
+
+
+def _conv_dim_numbers(ndim, layout):
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout[2:] if layout[1] == "C" else layout[1:-1]
+    rhs = "OI" + spatial
+    return layout, rhs, layout
+
+
+@register("Convolution")
+def _convolution(data, weight, *rest, kernel, num_filter, stride=None,
+                 dilate=None, pad=None, num_group=1, no_bias=False,
+                 layout=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """N-D convolution (reference: nn/convolution.cc). Default layout NCHW
+    for API parity; XLA re-lays-out for the MXU as needed."""
+    x = data
+    nd = len(kernel)
+    stride = tuple_param(stride, nd) or (1,) * nd
+    dilate = tuple_param(dilate, nd) or (1,) * nd
+    pad = tuple_param(pad, nd) or (0,) * nd
+    lhs_spec, rhs_spec, out_spec = _conv_dim_numbers(nd, layout)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, out_spec))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if not no_bias:
+        bias = rest[0]
+        c_axis = lhs_spec.index("C")
+        shape = [1] * y.ndim
+        shape[c_axis] = bias.size
+        y = y + bias.reshape(shape)
+    return y
+
+
+@register("Deconvolution")
+def _deconvolution(data, weight, *rest, kernel, num_filter, stride=None,
+                   dilate=None, pad=None, adj=None, target_shape=None,
+                   num_group=1, no_bias=True, layout=None, cudnn_tune=None,
+                   cudnn_off=False, workspace=1024):
+    """Transposed convolution (reference: nn/deconvolution.cc). weight is
+    (in_channels, num_filter//num_group, *kernel) like the reference."""
+    x = data
+    nd = len(kernel)
+    stride = tuple_param(stride, nd) or (1,) * nd
+    dilate = tuple_param(dilate, nd) or (1,) * nd
+    pad = tuple_param(pad, nd) or (0,) * nd
+    adj = tuple_param(adj, nd) or (0,) * nd
+    lhs_spec, _, out_spec = _conv_dim_numbers(nd, layout)
+    # grad-of-conv formulation: conv_transpose with IO spec
+    rhs_spec = "IO" + lhs_spec[2:]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, out_spec))
+    # padding for transposed conv: k - 1 - p (+ output adj handled by XLA)
+    pads = []
+    for k, s, p, d, a in zip(kernel, stride, pad, dilate, adj):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + a))
+    y = lax.conv_transpose(x, weight, strides=stride, padding=pads,
+                           rhs_dilation=dilate, dimension_numbers=dn,
+                           transpose_kernel=True)
+    if num_group != 1:
+        raise MXNetError("Deconvolution: num_group>1 not yet supported")
+    if not no_bias and rest:
+        bias = rest[0]
+        c_axis = lhs_spec.index("C")
+        shape = [1] * y.ndim
+        shape[c_axis] = bias.size
+        y = y + bias.reshape(shape)
+    return y
+
+
+@register("Pooling")
+def _pooling(data, *, kernel=(), pool_type="max", stride=None, pad=None,
+             global_pool=False, pooling_convention="valid", cudnn_off=False,
+             count_include_pad=True, p_value=2):
+    """N-D pooling (reference: nn/pooling.cc). Layout NC+spatial."""
+    x = data
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(x, axis=axes, keepdims=True)
+            if pool_type == "avg":
+                r = r / np.prod([x.shape[a] for a in axes])
+            return r
+        if pool_type == "lp":
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p_value),
+                                     axis=axes, keepdims=True), 1.0 / p_value)
+        raise MXNetError("Pooling: unknown pool_type %r" % pool_type)
+    kernel = tuple_param(kernel, nd)
+    stride = tuple_param(stride, nd) or (1,) * nd
+    pad = tuple_param(pad, nd) or (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad right edge so ceil((x + 2p - k)/s) + 1 windows fit
+        pads = [(0, 0), (0, 0)]
+        for i in range(nd):
+            size, k, s, p = x.shape[2 + i], kernel[i], stride[i], pad[i]
+            out = int(np.ceil((size + 2 * p - k) / s)) + 1
+            need = max((out - 1) * s + k - size - p, p)
+            pads.append((p, need))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / np.prod(kernel)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.power(jnp.abs(x), p_value), 0.0, lax.add,
+                              window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise MXNetError("Pooling: unknown pool_type %r" % pool_type)
+
+
+@register("UpSampling")
+def _upsampling(*data, scale, sample_type="nearest", num_args=1, num_filter=0,
+                multi_input_mode="concat", workspace=512):
+    xs = data
+    x = xs[0]
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        outs = []
+        for xi in xs:
+            s = scale
+            o = jnp.repeat(jnp.repeat(xi, s, axis=2), s, axis=3)
+            outs.append(o)
+        if len(outs) == 1:
+            return outs[0]
+        if multi_input_mode == "sum":
+            return sum(outs)
+        return jnp.concatenate(outs, axis=1)
+    if sample_type == "bilinear":
+        w_ = xs[1] if len(xs) > 1 else None
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+        return out
+    raise MXNetError("UpSampling: unknown sample_type %r" % sample_type)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", num_outputs=5,
+          visible_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
+          aux_write={3: 3, 4: 4}, takes_mode=True,
+          aliases=("BatchNorm_v1",))
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False,
+                _mode="predict"):
+    """Batch normalization (reference: nn/batch_norm.cc).
+
+    Outputs: (y, mean_used, inv_std_used, new_moving_mean, new_moving_var).
+    The last two are hidden aux outputs written back into the moving-stat
+    arrays by the executor/eager layer (the reference mutates aux_states
+    in-place inside the op; in the functional XLA world state is threaded).
+    """
+    x = data
+    ax = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    train = _mode == "train" and not use_global_stats
+    if train:
+        mean = jnp.mean(x, axis=ax)
+        var = jnp.var(x, axis=ax)
+        new_mm = momentum * moving_mean + (1 - momentum) * mean
+        new_mv = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    inv_std = lax.rsqrt(var + eps)
+    y = (x - mean.reshape(shape)) * inv_std.reshape(shape)
+    y = y * g.reshape(shape) + beta.reshape(shape)
+    return (y, mean, inv_std, lax.stop_gradient(new_mm),
+            lax.stop_gradient(new_mv))
+
+
+@register("LayerNorm")
+def _layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    x = data
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma, beta, *, eps=1e-3):
+    x = data
+    ax = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def _l2_normalization(data, *, eps=1e-10, mode="instance"):
+    x = data
+    if mode == "instance":
+        ax = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError("L2Normalization: unknown mode %r" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+    return x / norm
+
+
+@register("LRN")
+def _lrn(data, *, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    x = data
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    window = jnp.stack([sq[:, i:i + x.shape[1]] for i in range(nsize)]).sum(0)
+    return x / jnp.power(knorm + alpha / nsize * window, beta)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", needs_rng=True, takes_mode=True)
+def _dropout(key, data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
+             _mode="predict"):
+    """Dropout (reference: nn/dropout.cc). RNG key injected by the runtime."""
+    x = data
+    if (_mode != "train" and mode != "always") or p <= 0:
+        return x
+    shape = list(x.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(x.dtype)
+    return x * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (reference: rnn-inl.h — whole multi-layer sequence as one op)
+# ---------------------------------------------------------------------------
+
+
+def _rnn_arity(params):
+    n = 1
+    if params.get("state_outputs", False):
+        n += 2 if params.get("mode", "lstm") == "lstm" else 1
+    return n
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Size of the packed 1-D parameter vector (layout documented in
+    rnn_unpack_params)."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _ in range(d):
+            size += g * state_size * in_sz      # i2h weight
+            size += g * state_size * state_size  # h2h weight
+            size += 2 * g * state_size           # i2h + h2h bias
+    return size
+
+
+def rnn_unpack_params(params, num_layers, input_size, state_size,
+                      bidirectional, mode):
+    """Unpack flat param vector: per layer, per direction:
+    [W_i2h (g*H, in), W_h2h (g*H, H), b_i2h (g*H), b_h2h (g*H)]."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    out = []
+    off = 0
+    H = state_size
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * d
+        dirs = []
+        for _ in range(d):
+            wi = params[off:off + g * H * in_sz].reshape(g * H, in_sz)
+            off += g * H * in_sz
+            wh = params[off:off + g * H * H].reshape(g * H, H)
+            off += g * H * H
+            bi = params[off:off + g * H]
+            off += g * H
+            bh = params[off:off + g * H]
+            off += g * H
+            dirs.append((wi, wh, bi, bh))
+        out.append(dirs)
+    return out
+
+
+def _rnn_cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            gates = gates_x + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+    elif mode == "gru":
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            gh = h @ wh.T + bh
+            rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h = (1 - z) * n + z * h
+            return (h,), h
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            h = act(gates_x + h @ wh.T + bh)
+            return (h,), h
+    return step
+
+
+def _run_rnn_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
+    """x: (T, B, in). Returns (out (T,B,H), hT, cT)."""
+    H = wh.shape[1]
+    # hoist the input projection out of the scan: one big MXU matmul
+    gates_x = jnp.einsum("tbi,gi->tbg", x, wi) + bi
+    step = _rnn_cell_step(mode, H)
+    if reverse:
+        gates_x = jnp.flip(gates_x, axis=0)
+
+    def body(carry, gx):
+        carry, out = step(carry, gx, wh, bh)
+        return carry, out
+
+    init = (h0, c0) if mode == "lstm" else (h0,)
+    carry, outs = lax.scan(body, init, gates_x)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    if mode == "lstm":
+        return outs, carry[0], carry[1]
+    return outs, carry[0], None
+
+
+@register("RNN", num_outputs=_rnn_arity, needs_rng=True, takes_mode=True)
+def _rnn(key, data, params, state, *rest, state_size, num_layers,
+         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+         lstm_state_clip_min=None, lstm_state_clip_max=None,
+         lstm_state_clip_nan=False, _mode="predict"):
+    """Fused multi-layer RNN over a whole sequence.
+
+    data: (T, B, input_size); params: flat 1-D vector (rnn_param_size);
+    state: (num_layers*d, B, H); for LSTM a second state input (cell).
+    Maps the reference's cuDNN fused RNN to lax.scan — the whole sequence
+    runs inside one XLA computation (no per-timestep dispatch).
+    """
+    T, B, input_size = data.shape
+    d = 2 if bidirectional else 1
+    H = state_size
+    cell0 = rest[0] if (mode == "lstm" and rest) else None
+    layers = rnn_unpack_params(params, num_layers, input_size, H,
+                               bidirectional, mode)
+    x = data
+    h_finals, c_finals = [], []
+    for li, dirs in enumerate(layers):
+        if p > 0 and _mode == "train" and li > 0:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - p
+            x = x * jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype) / keep
+        outs = []
+        for di, (wi, wh, bi, bh) in enumerate(dirs):
+            idx = li * d + di
+            h0 = state[idx]
+            c0 = cell0[idx] if cell0 is not None else None
+            o, hT, cT = _run_rnn_layer(x, h0, c0, wi, wh, bi, bh, mode,
+                                       reverse=(di == 1))
+            outs.append(o)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+    result = [x]
+    if state_outputs:
+        result.append(jnp.stack(h_finals))
+        if mode == "lstm":
+            result.append(jnp.stack(c_finals))
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation")
+def _correlation(a, b, *, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    raise MXNetError("Correlation: not implemented yet")
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_kl(x, *, sparseness_target=0.1, penalty=0.001, momentum=0.9):
+    return x
+
+
+@register("Custom")
+def _custom(*xs, op_type):
+    raise MXNetError(
+        "Custom op %r must be invoked through mxnet_tpu.operator "
+        "(CustomOp python bridge)" % op_type)

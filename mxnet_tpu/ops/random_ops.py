@@ -1,0 +1,129 @@
+"""Random sampling operators.
+
+Reference surface: src/operator/random/sample_op.cc (uniform, normal, gamma,
+exponential, poisson, negative_binomial, generalized_negative_binomial,
+randint), multisample_op.cc, shuffle_op.cc, unique_sample_op.cc.
+
+TPU-native: counter-based stateless RNG (jax.random). Every op takes a
+PRNGKey as its first (hidden) input, injected by the runtime — eager calls
+draw from the global seed state (mxnet_tpu.random), jitted graphs thread the
+key as an argument so each step gets fresh randomness without retracing.
+(The reference's per-device parallel RNG resource, random_generator.h, is
+subsumed: splitting keys is free and reproducible.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_from_name
+from .registry import register
+
+
+def _dt(dtype, default="float32"):
+    if dtype is None or dtype == "None":
+        dtype = default
+    return dtype_from_name(dtype)
+
+
+@register("_random_uniform", aliases=("random_uniform", "uniform"),
+          needs_rng=True)
+def _uniform(key, *, low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None):
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", aliases=("random_normal", "normal"),
+          needs_rng=True)
+def _normal(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None):
+    return loc + scale * jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+@register("_random_gamma", aliases=("random_gamma",), needs_rng=True)
+def _gamma(key, *, alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None):
+    return beta * jax.random.gamma(key, alpha, tuple(shape), _dt(dtype))
+
+
+@register("_random_exponential", aliases=("random_exponential",),
+          needs_rng=True)
+def _exponential(key, *, lam=1.0, shape=(1,), dtype=None, ctx=None):
+    return jax.random.exponential(key, tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+def _poisson(key, *, lam=1.0, shape=(1,), dtype=None, ctx=None):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          needs_rng=True)
+def _neg_binomial(key, *, k=1, p=1.0, shape=(1,), dtype=None, ctx=None):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",), needs_rng=True)
+def _gen_neg_binomial(key, *, mu=1.0, alpha=1.0, shape=(1,), dtype=None,
+                      ctx=None):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("random_randint", "randint"),
+          needs_rng=True)
+def _randint(key, *, low=0, high=1, shape=(1,), dtype="int32", ctx=None):
+    return jax.random.randint(key, tuple(shape), low, high,
+                              _dt(dtype, "int32"))
+
+
+@register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
+def _sample_uniform(key, low, high, *, shape=(), dtype=None):
+    s = tuple(low.shape) + tuple(shape)
+    u = jax.random.uniform(key, s, _dt(dtype))
+    return low.reshape(low.shape + (1,) * len(shape)) + \
+        (high - low).reshape(low.shape + (1,) * len(shape)) * u
+
+
+@register("_sample_normal", aliases=("sample_normal",), needs_rng=True)
+def _sample_normal(key, mu, sigma, *, shape=(), dtype=None):
+    s = tuple(mu.shape) + tuple(shape)
+    z = jax.random.normal(key, s, _dt(dtype))
+    return mu.reshape(mu.shape + (1,) * len(shape)) + \
+        sigma.reshape(sigma.shape + (1,) * len(shape)) * z
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          needs_rng=True,
+          num_outputs=lambda p: 2 if p.get("get_prob", False) else 1)
+def _sample_multinomial(key, data, *, shape=(), get_prob=False,
+                        dtype="int32"):
+    """data: (..., k) probabilities; samples category indices."""
+    n = int(jnp.asarray(shape).prod()) if shape else 1
+    shp = tuple(shape) if shape else ()
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    flatshape = data.shape[:-1] + shp
+    idx = jax.random.categorical(
+        key, logits[..., None, :] if shp else logits,
+        axis=-1, shape=flatshape)
+    out = idx.astype(_dt(dtype, "int32"))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.broadcast_to(logits[..., None, :] if shp else logits,
+                             flatshape + (data.shape[-1],)),
+            idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, lp.astype(jnp.float32)
+    return out
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True)
+def _shuffle(key, x):
+    return jax.random.permutation(key, x, axis=0)
+
+
+@register("bernoulli", needs_rng=True)
+def _bernoulli(key, *, prob=0.5, shape=(1,), dtype=None, ctx=None):
+    return jax.random.bernoulli(key, prob, tuple(shape)).astype(_dt(dtype))

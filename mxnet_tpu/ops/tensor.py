@@ -1,0 +1,463 @@
+"""Shape manipulation, indexing, ordering, linalg, sequence and dot ops.
+
+Reference surface: src/operator/tensor/matrix_op.cc, indexing_op.cc,
+ordering_op.cc, la_op.cc, dot.cc, init_op.cc, src/operator/sequence_*.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def _mx_reshape_shape(src_shape, target):
+    """Implement the reference's Reshape special codes (matrix_op.cc docs):
+    0 copy dim, -1 infer, -2 copy rest, -3 merge two dims, -4 split dim."""
+    src = list(src_shape)
+    out = []
+    i = 0  # cursor into src
+    t = list(target)
+    j = 0
+    while j < len(t):
+        d = t[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = t[j + 1], t[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(int(d))
+            if i < len(src):
+                i += 1
+        j += 1
+    if out.count(-1) > 1:
+        raise MXNetError("Reshape: more than one -1 in %r" % (target,))
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(x, *, shape, reverse=False):
+    tgt = _mx_reshape_shape(x.shape if not reverse else x.shape[::-1],
+                            shape if not reverse else tuple(shape)[::-1])
+    if reverse:
+        tgt = tgt[::-1]
+    return jnp.reshape(x, tgt)
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(x, *, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(range(x.ndim))[::-1]
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def _expand_dims(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(x, *, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("slice")
+def _slice(x, *, begin, end, step=None):
+    step = step or (None,) * len(begin)
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis")
+def _slice_axis(x, *, axis, begin, end):
+    if end is None:
+        end = x.shape[axis]
+    return lax.slice_in_dim(x, begin, end, axis=axis)
+
+
+@register("slice_like")
+def _slice_like(x, y, *, axes=()):
+    axes = tuple(axes) if axes else tuple(range(y.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def _split_arity(params):
+    return int(params.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_arity)
+def _split(x, *, num_outputs, axis=1, squeeze_axis=False):
+    outs = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register("tile")
+def _tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def _repeat(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("Pad", aliases=("pad",))
+def _pad(x, *, mode="constant", pad_width=(), constant_value=0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError("Pad: unknown mode %r" % mode)
+
+
+@register("flip", aliases=("reverse",))
+def _flip(x, *, axis):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axes)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# indexing / embedding
+# ---------------------------------------------------------------------------
+
+
+@register("take")
+def _take(a, indices, *, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode != "wrap" else "wrap")
+
+
+@register("batch_take", aliases=("pick",))
+def _batch_take(a, indices, *, axis=1, keepdims=False):
+    idx = indices.astype(jnp.int32)
+    out = jnp.take_along_axis(a, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("Embedding")
+def _embedding(data, weight, *, input_dim, output_dim, dtype="float32",
+               sparse_grad=False):
+    """Embedding lookup (reference: indexing_op.h EmbeddingOpForward).
+    On TPU this lowers to a gather feeding the MXU; the sparse_grad path is
+    handled by the optimizer-side row_sparse update."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def _one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_from_name
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * on_value + (1 - oh) * off_value
+    return out.astype(dtype_from_name(dtype))
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, *, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, rhs, *, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("where")
+def _where(cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+@register("ravel_multi_index")
+def _ravel(data, *, shape):
+    idx = tuple(data.astype(jnp.int32))
+    import numpy as _np
+    strides = _np.cumprod([1] + list(shape[::-1][:-1]))[::-1]
+    out = sum(i * int(s) for i, s in zip(idx, strides))
+    return out.astype(jnp.float32)
+
+
+@register("unravel_index")
+def _unravel(data, *, shape):
+    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int32), shape))
+    return out.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("topk", num_outputs=lambda p: 2 if p.get("ret_typ", "indices") == "both" else 1)
+def _topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_from_name
+    xa = jnp.moveaxis(x, axis, -1)
+    vals, idxs = lax.top_k(-xa if is_ascend else xa, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(dtype_from_name(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        _, ii = lax.top_k(-xa if is_ascend else xa, k)
+        oh = jax.nn.one_hot(ii, xa.shape[-1], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, axis)
+    raise MXNetError("topk: bad ret_typ %r" % ret_typ)
+
+
+@register("sort")
+def _sort(x, *, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def _argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_from_name
+    out = jnp.argsort(x if is_ascend else -x, axis=axis)
+    return out.astype(dtype_from_name(dtype))
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (reference: dot.cc, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def _dot(a, b, *, transpose_a=False, transpose_b=False):
+    """General dot: contracts last axis of a with first axis of b (mxnet
+    semantics), with transpose flags for the 2-D case. Lowers to the MXU."""
+    if transpose_a:
+        a = jnp.transpose(a, tuple(range(1, a.ndim)) + (0,)) if a.ndim > 2 else a.T
+    if transpose_b:
+        b = jnp.transpose(b, (b.ndim - 1,) + tuple(range(b.ndim - 1))) if b.ndim > 2 else b.T
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def _batch_dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(a, b, c, *, transpose_a=False, transpose_b=False,
+                 alpha=1.0, beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(a, b, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                  axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(l):
+    inv_l = jax.scipy.linalg.solve_triangular(
+        l, jnp.broadcast_to(jnp.eye(l.shape[-1], dtype=l.dtype), l.shape), lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        lower = not lower
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2), lower=not lower)
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jax.scipy.linalg.solve_triangular(a, b, lower=lower)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    if rightside:
+        return alpha * jnp.matmul(b, tri)
+    return alpha * jnp.matmul(tri, b)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(a, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def _linalg_syevd(a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def _linalg_gelqf(a):
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: sequence_mask.cc / sequence_last.cc / sequence_reverse.cc)
+# layout: (seq_len, batch, ...) like the reference
+# ---------------------------------------------------------------------------
+
+
+def _seq_mask(length, maxlen):
+    return jnp.arange(maxlen)[:, None] < length[None, :]
+
+
+@register("SequenceMask")
+def _sequence_mask(data, *args, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or not args:
+        return data
+    sequence_length = args[0]
+    maxlen = data.shape[axis]
+    mask = _seq_mask(sequence_length.astype(jnp.int32), maxlen)  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def _sequence_last(data, *args, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not args:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    sequence_length = args[0].astype(jnp.int32)
+    idx = jnp.clip(sequence_length - 1, 0, data.shape[axis] - 1)  # (B,)
+    d = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        d, idx.reshape((1, -1) + (1,) * (d.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, *args, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not args:
+        return jnp.flip(data, axis=0)
+    sequence_length = args[0].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)[:, None]  # (T,1)
+    L = sequence_length[None, :]  # (1,B)
+    src = jnp.where(t < L, L - 1 - t, t)  # (T,B)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+@register("diag")
+def _diag(x, *, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("histogram", num_outputs=2)
+def _histogram(x, *, bin_cnt=10, range=None):
+    lo, hi = range if range is not None else (0.0, 1.0)
+    cnt, edges = jnp.histogram(x.reshape(-1), bins=bin_cnt, range=(lo, hi))
+    return cnt.astype(jnp.float32), edges.astype(jnp.float32)

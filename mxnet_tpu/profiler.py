@@ -1,0 +1,126 @@
+"""Profiler (reference: src/profiler/, python/mxnet/profiler.py).
+
+The reference emits chrome://tracing JSON from engine hooks. TPU-native:
+jax.profiler emits full XLA/TPU traces viewable in TensorBoard/Perfetto —
+strictly more detail than the reference's per-op wall times. This module
+keeps the reference's Python API shape (set_config/set_state/dump plus
+scoped Task/Frame/Marker) on top of jax.profiler.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+_config = {"filename": "/tmp/mxtpu_profile", "profile_all": False}
+_running = {"on": False}
+_aggregate = {}
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state in ("run", True):
+        if not _running["on"]:
+            jax.profiler.start_trace(_config["filename"])
+            _running["on"] = True
+    else:
+        if _running["on"]:
+            jax.profiler.stop_trace()
+            _running["on"] = False
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def dump(finished=True, profile_process="worker"):
+    set_state("stop")
+
+
+def dumps(reset=False):
+    """Aggregate stats string (reference: MXAggregateProfileStatsPrint)."""
+    lines = ["%-40s %10s %12s" % ("Name", "Calls", "Total(ms)")]
+    for name, (calls, total) in sorted(_aggregate.items()):
+        lines.append("%-40s %10d %12.3f" % (name, calls, total * 1e3))
+    if reset:
+        _aggregate.clear()
+    return "\n".join(lines)
+
+
+class _Scope:
+    """User-scoped profiling objects (reference: profiler.py:210-400)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+        self._tm = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        self._tm = jax.profiler.TraceAnnotation(self.name)
+        self._tm.__enter__()
+
+    def stop(self):
+        if self._tm is not None:
+            self._tm.__exit__(None, None, None)
+            calls, total = _aggregate.get(self.name, (0, 0.0))
+            _aggregate[self.name] = (calls + 1,
+                                     total + time.perf_counter() - self._t0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Scope):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name)
+
+
+class Frame(_Scope):
+    def __init__(self, domain=None, name="frame"):
+        super().__init__(name)
+
+
+class Event(_Scope):
+    def __init__(self, name="event"):
+        super().__init__(name)
+
+
+class Marker:
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        pass
+
+
+class Counter:
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name

@@ -1,0 +1,35 @@
+"""Global RNG state (reference: python/mxnet/random.py, mx.random.seed).
+
+A single counter-based root key; eager random ops split a fresh subkey per
+call. Reproducible: mx.random.seed(n) resets the stream. Jitted graphs do
+NOT read this state implicitly — the executor threads a key argument so
+compiled steps stay pure (see symbol/executor)."""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _root():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (API parity: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh PRNGKey from the global stream."""
+    root = _root()
+    _state.key, sub = jax.random.split(root)
+    return sub
+
+
+def current_key():
+    return _root()

@@ -1,0 +1,14 @@
+"""Test configuration: run the whole suite on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-device tests run
+without a cluster by faking devices on one host
+(xla_force_host_platform_device_count), the way the reference runs dist
+kvstore tests with local worker/server processes.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,189 @@
+"""Autograd tests (reference model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])  # 3x^2
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy())
+    assert np.allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])  # only the direct factor
+
+
+def test_blockgrad_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_grad_fn():
+    x = nd.array([2.0])
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    assert np.allclose(g.asnumpy(), [12.0])
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 1.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = nd.sum(x * 3)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_recording_state():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_training_mode_affects_dropout():
+    x = nd.ones((100, 100))
+    eval_out = nd.Dropout(x, p=0.5)
+    assert np.allclose(eval_out.asnumpy(), 1.0)
+    with autograd.record():
+        train_out = nd.Dropout(x, p=0.5)
+    vals = np.unique(train_out.asnumpy())
+    assert set(np.round(vals, 3)).issubset({0.0, 2.0})
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert np.allclose(g1, [4.0])
+    with pytest.raises(mx.MXNetError):
+        y.backward()  # graph freed now
+
+
+def test_multi_output_partial_use():
+    x = nd.array([[5.0, 1.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        vals, idxs = nd.topk(x, k=2, ret_typ="both")
+        loss = vals.sum()
+    loss.backward()
+    # gradient flows only to the top-2 entries
+    assert np.allclose(x.grad.asnumpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_custom_function():
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    f = Double()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    assert np.allclose(y.asnumpy(), [6.0])
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_numeric_vs_autograd():
+    """Finite-difference check (reference: check_numeric_gradient)."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 5).astype(np.float32)
+
+    def f_np(x):
+        return np.tanh(x @ x.T).sum()
+
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.tanh(nd.dot(x, x.T)))
+    y.backward()
+    eps = 1e-3
+    num = np.zeros_like(xv)
+    for i in range(xv.shape[0]):
+        for j in range(xv.shape[1]):
+            xp = xv.copy(); xp[i, j] += eps
+            xm = xv.copy(); xm[i, j] -= eps
+            num[i, j] = (f_np(xp) - f_np(xm)) / (2 * eps)
+    assert np.allclose(x.grad.asnumpy(), num, atol=1e-2, rtol=1e-2)
