@@ -25,6 +25,14 @@ from .ndarray import NDArray
 from . import autograd
 from . import random
 from . import profiler
+from . import name
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+from .cached_op import CachedOp
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
-           "random", "MXNetError"]
+           "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",
+           "CachedOp", "name"]

@@ -1,0 +1,417 @@
+"""Graph IR: the TPU-native equivalent of NNVM's node graph.
+
+Reference: NNVM Graph/Node/NodeEntry (3rdparty/tvm/nnvm, used by
+src/executor/graph_executor.cc and src/imperative/cached_op.cc).
+
+TPU-native design: the graph is a tiny pure-Python DAG whose nodes hold
+registered ops; "lowering" is building ONE jax-traceable Python function
+over the whole graph and handing it to jax.jit. XLA then subsumes every
+NNVM pass the reference runs at bind time: PlanMemory -> buffer assignment,
+DetectInplaceAddTo -> fusion, AttachOpExecs/bulking -> single compiled
+computation, PlaceDevice -> sharding annotations.
+
+The same builder serves the Executor (Module/symbolic path), CachedOp
+(Gluon hybridize path) and Symbol.eval.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops import registry as _reg
+
+
+class Node:
+    """One graph node: a variable (op is None) or an op application.
+
+    inputs: list of (Node, output_index) edges.
+    """
+
+    __slots__ = ("op", "inputs", "params", "name", "attrs", "is_aux",
+                 "__weakref__")
+
+    def __init__(self, op, inputs, params, name, is_aux=False, attrs=None):
+        self.op = op
+        self.inputs = inputs
+        self.params = params
+        self.name = name
+        self.is_aux = is_aux
+        self.attrs = attrs or {}
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def n_visible(self):
+        if self.op is None:
+            return 1
+        vis = self.op.visible_outputs
+        if callable(vis):
+            return vis(self.params)
+        return vis or self.op.out_arity(self.params)
+
+    def n_raw(self):
+        if self.op is None:
+            return 1
+        return self.op.out_arity(self.params)
+
+    def __repr__(self):
+        if self.op is None:
+            return "Var(%s)" % self.name
+        return "Node(%s:%s)" % (self.op.name, self.name)
+
+
+def topo_order(output_entries):
+    """Topological order of all nodes reachable from (node, idx) entries.
+    Iterative DFS (the reference's NNVM PostOrderDFSVisit)."""
+    order = []
+    seen = set()
+    stack = [(n, False) for n, _ in reversed(output_entries)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in seen:
+            continue
+        if expanded:
+            seen.add(id(node))
+            order.append(node)
+        else:
+            stack.append((node, True))
+            for inp, _ in reversed(node.inputs):
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+    return order
+
+
+def collect_vars(output_entries):
+    """Return (arg_nodes, aux_nodes) in first-seen topo order."""
+    args, aux = [], []
+    for node in topo_order(output_entries):
+        if node.is_variable:
+            (aux if node.is_aux else args).append(node)
+    return args, aux
+
+
+def build_graph_fn(output_entries, mode="predict"):
+    """Build a pure jax function evaluating the graph.
+
+    Returns (fn, arg_names, aux_names, needs_rng) where::
+
+        fn(args: dict[str, array], aux: dict[str, array], key)
+            -> (list[array] outputs, dict[str, array] aux_updates)
+
+    aux_updates carries new values for mutable aux states (BatchNorm moving
+    stats) — the functional-state threading that replaces the reference's
+    in-place aux mutation (src/operator/nn/batch_norm.cc writes aux_states
+    in place; XLA state must be explicit).
+    """
+    order = topo_order(output_entries)
+    arg_nodes, aux_nodes = collect_vars(output_entries)
+    arg_names = [n.name for n in arg_nodes]
+    aux_names = [n.name for n in aux_nodes]
+    needs_rng = any((not n.is_variable) and n.op.needs_rng for n in order)
+
+    # precompute per-node static params (defaults applied once)
+    node_params = {}
+    for node in order:
+        if node.is_variable:
+            continue
+        p = _reg.apply_defaults(node.op, node.params)
+        if node.op.takes_mode:
+            p["_mode"] = mode
+        node_params[id(node)] = p
+
+    train = mode == "train"
+
+    def fn(args, aux, key=None):
+        values = {}
+        aux_updates = {}
+        for node in order:
+            if node.is_variable:
+                if node.is_aux:
+                    values[id(node)] = (aux[node.name],)
+                else:
+                    values[id(node)] = (args[node.name],)
+                continue
+            arrs = [values[id(n)][i] for n, i in node.inputs]
+            op = node.op
+            if op.needs_rng:
+                if key is None:
+                    raise MXNetError(
+                        "graph contains random op %s but no PRNG key was "
+                        "provided" % op.name)
+                key, sub = jax.random.split(key)
+                arrs = [sub] + arrs
+            raw = op.fn(*arrs, **node_params[id(node)])
+            if not isinstance(raw, tuple):
+                raw = (raw,)
+            values[id(node)] = raw
+            if op.aux_write and train:
+                for oi, ii in op.aux_write.items():
+                    in_node, _ = node.inputs[ii]
+                    if in_node.is_variable and in_node.is_aux:
+                        aux_updates[in_node.name] = raw[oi]
+        outs = [values[id(n)][i] for n, i in output_entries]
+        return outs, aux_updates
+
+    return fn, arg_names, aux_names, needs_rng
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype inference (reference: src/executor/infer_graph_attr_pass.cc).
+# Forward-propagates jax.ShapeDtypeStruct through the graph; parameter
+# variables with unknown shape are resolved by per-op rules (the analog of
+# the reference's per-op FInferShape filling in weight shapes).
+# ---------------------------------------------------------------------------
+
+# op name -> rule(in_structs, params, in_nodes) -> list in_structs (completed)
+_PARAM_SHAPE_RULES = {}
+
+
+def register_shape_rule(name):
+    def deco(fn):
+        _PARAM_SHAPE_RULES[name] = fn
+        return fn
+    return deco
+
+
+def _struct(shape, dtype):
+    return jax.ShapeDtypeStruct(tuple(int(s) for s in shape), dtype)
+
+
+def _f32_like(in_structs):
+    for s in in_structs:
+        if s is not None:
+            return s.dtype
+    return jnp.float32
+
+
+@register_shape_rule("FullyConnected")
+def _fc_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None:
+        return ins
+    dt = data.dtype
+    if params.get("flatten", True) and len(data.shape) > 1:
+        in_units = 1
+        for s in data.shape[1:]:
+            in_units *= int(s)
+    else:
+        in_units = data.shape[-1]
+    nh = params["num_hidden"]
+    out = list(ins)
+    if out[1] is None:
+        out[1] = _struct((nh, in_units), dt)
+    if len(out) > 2 and out[2] is None:
+        out[2] = _struct((nh,), dt)
+    return out
+
+
+@register_shape_rule("Convolution")
+def _conv_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None:
+        return ins
+    dt = data.dtype
+    kernel = tuple(params["kernel"]) if not isinstance(params["kernel"], int) \
+        else (params["kernel"],)
+    nf = params["num_filter"]
+    ng = params.get("num_group", 1) or 1
+    layout = params.get("layout")
+    c_axis = 1 if (layout is None or layout[1] == "C") else len(data.shape) - 1
+    cin = data.shape[c_axis]
+    out = list(ins)
+    if out[1] is None:
+        out[1] = _struct((nf, cin // ng) + kernel, dt)
+    if len(out) > 2 and out[2] is None:
+        out[2] = _struct((nf,), dt)
+    return out
+
+
+@register_shape_rule("Deconvolution")
+def _deconv_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None:
+        return ins
+    dt = data.dtype
+    kernel = tuple(params["kernel"])
+    nf = params["num_filter"]
+    ng = params.get("num_group", 1) or 1
+    cin = data.shape[1]
+    out = list(ins)
+    if out[1] is None:
+        out[1] = _struct((cin, nf // ng) + kernel, dt)
+    if len(out) > 2 and out[2] is None:
+        out[2] = _struct((nf,), dt)
+    return out
+
+
+def _norm_rule_factory(n_stats):
+    def rule(ins, params, nodes):
+        data = ins[0]
+        if data is None:
+            return ins
+        axis = params.get("axis", 1)
+        c = data.shape[axis % len(data.shape)]
+        out = list(ins)
+        for i in range(1, min(len(out), 1 + n_stats)):
+            if out[i] is None:
+                out[i] = _struct((c,), jnp.float32)
+        return out
+    return rule
+
+
+_PARAM_SHAPE_RULES["BatchNorm"] = _norm_rule_factory(4)
+_PARAM_SHAPE_RULES["BatchNorm_v1"] = _norm_rule_factory(4)
+_PARAM_SHAPE_RULES["InstanceNorm"] = _norm_rule_factory(2)
+
+
+@register_shape_rule("LayerNorm")
+def _ln_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None:
+        return ins
+    axis = params.get("axis", -1)
+    c = data.shape[axis % len(data.shape)]
+    out = list(ins)
+    for i in (1, 2):
+        if i < len(out) and out[i] is None:
+            out[i] = _struct((c,), data.dtype)
+    return out
+
+
+@register_shape_rule("Embedding")
+def _emb_rule(ins, params, nodes):
+    out = list(ins)
+    if out[1] is None:
+        out[1] = _struct((params["input_dim"], params["output_dim"]),
+                         jnp.float32)
+    return out
+
+
+@register_shape_rule("LeakyReLU")
+def _prelu_rule(ins, params, nodes):
+    if params.get("act_type") != "prelu" or len(ins) < 2:
+        return ins
+    data = ins[0]
+    if data is None or ins[1] is not None:
+        return ins
+    out = list(ins)
+    c = data.shape[1] if len(data.shape) > 1 else 1
+    out[1] = _struct((c,), data.dtype)
+    return out
+
+
+@register_shape_rule("RNN")
+def _rnn_rule(ins, params, nodes):
+    from .ops.nn import rnn_param_size
+    data = ins[0]
+    if data is None:
+        return ins
+    dt = data.dtype
+    T, B, input_size = data.shape
+    H = params["state_size"]
+    L = params["num_layers"]
+    bi = params.get("bidirectional", False)
+    d = 2 if bi else 1
+    out = list(ins)
+    if out[1] is None:
+        out[1] = _struct(
+            (rnn_param_size(L, input_size, H, bi, params.get("mode", "lstm")),),
+            dt)
+    for i in range(2, len(out)):
+        if out[i] is None:
+            out[i] = _struct((L * d, B, H), dt)
+    return out
+
+
+@register_shape_rule("SoftmaxOutput")
+def _softmax_out_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None or len(ins) < 2 or ins[1] is not None:
+        return ins
+    out = list(ins)
+    if params.get("multi_output"):
+        lbl = (data.shape[0],) + tuple(data.shape[2:])
+    elif params.get("preserve_shape"):
+        lbl = tuple(data.shape[:-1])
+    else:
+        lbl = (data.shape[0],)
+    out[1] = _struct(lbl, jnp.float32)
+    return out
+
+
+def _regression_rule(ins, params, nodes):
+    data = ins[0]
+    if data is None or len(ins) < 2 or ins[1] is not None:
+        return ins
+    out = list(ins)
+    out[1] = _struct(data.shape, data.dtype)
+    return out
+
+
+for _n in ("LinearRegressionOutput", "MAERegressionOutput",
+           "LogisticRegressionOutput"):
+    _PARAM_SHAPE_RULES[_n] = _regression_rule
+
+
+def infer_structs(output_entries, known, mode="predict"):
+    """Propagate ShapeDtypeStructs through the graph.
+
+    known: dict var_name -> ShapeDtypeStruct (or (shape, dtype)).
+    Returns dict: var_name -> struct for every variable it could resolve,
+    plus a dict node-id -> list of output structs.
+    """
+    norm = {}
+    for k, v in known.items():
+        if isinstance(v, jax.ShapeDtypeStruct):
+            norm[k] = v
+        elif isinstance(v, tuple) and v and isinstance(v[0], (tuple, list)):
+            norm[k] = _struct(v[0], v[1])
+        else:
+            norm[k] = _struct(v, jnp.float32)
+    known = norm
+
+    order = topo_order(output_entries)
+    var_structs = dict(known)
+    out_structs = {}
+
+    for node in order:
+        if node.is_variable:
+            s = var_structs.get(node.name)
+            out_structs[id(node)] = [s]
+            continue
+        ins = [out_structs[id(n)][i] for n, i in node.inputs]
+        rule = _PARAM_SHAPE_RULES.get(node.op.name)
+        if rule is not None and any(s is None for s in ins):
+            ins = rule(ins, _reg.apply_defaults(node.op, node.params),
+                       [n for n, _ in node.inputs])
+            # write resolved structs back onto variable inputs
+            for (in_node, _), s in zip(node.inputs, ins):
+                if in_node.is_variable and s is not None and \
+                        var_structs.get(in_node.name) is None:
+                    var_structs[in_node.name] = s
+                    out_structs[id(in_node)] = [s]
+        if any(s is None for s in ins):
+            missing = [n.name for (n, _), s in zip(node.inputs, ins)
+                       if s is None]
+            out_structs[id(node)] = [None] * node.n_raw()
+            continue
+        params = _reg.apply_defaults(node.op, node.params)
+        if node.op.takes_mode:
+            params["_mode"] = mode
+        args = list(ins)
+        if node.op.needs_rng:
+            args = [jax.ShapeDtypeStruct((2,), jnp.uint32)] + args
+        try:
+            raw = jax.eval_shape(lambda *a, _p=params, _f=node.op.fn:
+                                 _f(*a, **_p), *args)
+        except Exception as e:  # pragma: no cover - surface as infer error
+            raise MXNetError(
+                "shape inference failed at op %s(%s): %s"
+                % (node.op.name, node.name, e)) from None
+        if not isinstance(raw, tuple):
+            raw = (raw,)
+        out_structs[id(node)] = list(raw)
+
+    return var_structs, out_structs

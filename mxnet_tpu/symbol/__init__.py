@@ -1,0 +1,40 @@
+"""The `sym` namespace: Symbol + one function per registered operator.
+
+Reference: python/mxnet/symbol/__init__.py.
+"""
+import sys as _sys
+import types as _types
+
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     zeros, ones, arange)
+from .register import populate as _populate, make_symbol_func
+
+_symbol_ns = _sys.modules[__name__]
+
+_populate(globals())
+
+# sym.random.* / sym.linalg.* / sym.contrib.* namespaces
+random = _types.ModuleType(__name__ + ".random")
+_g = globals()
+for _name in ("uniform", "normal", "randint"):
+    if ("_random_%s" % _name) in _g:
+        random.__dict__[_name] = _g["_random_%s" % _name]
+_sys.modules[__name__ + ".random"] = random
+
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _name in ("gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+              "sumlogdiag", "syevd", "gelqf"):
+    _key = "_linalg_%s" % _name
+    if _key in _g:
+        linalg.__dict__[_name] = _g[_key]
+_sys.modules[__name__ + ".linalg"] = linalg
+
+contrib = _types.ModuleType(__name__ + ".contrib")
+_sys.modules[__name__ + ".contrib"] = contrib
+
+
+def _refresh_namespaces():
+    _populate(_g)
+    for _name in list(_g):
+        if _name.startswith("_contrib_"):
+            contrib.__dict__[_name[len("_contrib_"):]] = _g[_name]

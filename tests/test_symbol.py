@@ -1,0 +1,248 @@
+"""Symbol graph construction, inference, serialization, executor tests.
+
+Mirrors the reference's tests/python/unittest/test_symbol.py and
+test_executor.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_variable_and_compose():
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    z = x + y
+    assert set(z.list_arguments()) == {"x", "y"}
+    assert z.num_outputs == 1
+
+
+def test_auto_variable_creation():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    args = fc.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias"]
+    fc_nb = mx.sym.FullyConnected(data=data, num_hidden=10, no_bias=True,
+                                  name="fc2")
+    assert fc_nb.list_arguments() == ["data", "fc2_weight"]
+
+
+def test_auto_naming():
+    with mx.name.NameManager():
+        data = mx.sym.var("data")
+        c1 = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8)
+        c2 = mx.sym.Convolution(data=c1, kernel=(3, 3), num_filter=8)
+        assert c1.name == "convolution0"
+        assert c2.name == "convolution1"
+        assert "convolution0_weight" in c2.list_arguments()
+
+
+def test_batchnorm_aux_states():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_infer_shape_mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(data=h, act_type="relu")
+    out = mx.sym.FullyConnected(data=h, num_hidden=10, name="fc2")
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 784))
+    args = out.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (128, 784)
+    assert d["fc1_bias"] == (128,)
+    assert d["fc2_weight"] == (10, 128)
+    assert out_shapes == [(32, 10)]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=16,
+                           pad=(1, 1), name="c1")
+    p = mx.sym.Pooling(data=c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = p.infer_shape(data=(4, 3, 32, 32))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (16, 3, 3, 3)
+    assert out_shapes == [(4, 16, 16, 16)]
+
+
+def test_group_and_internals():
+    x = mx.sym.var("x")
+    a = x * 2
+    b = x + 1
+    g = mx.sym.Group([a, b])
+    assert g.num_outputs == 2
+    outs = g.list_outputs()
+    assert len(outs) == 2
+    internals = (a + b).get_internals()
+    assert internals.num_outputs >= 3
+
+
+def test_json_roundtrip():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(data=net, name="sm")
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    a1, o1, _ = net.infer_shape(data=(2, 8))
+    a2, o2, _ = net2.infer_shape(data=(2, 8))
+    assert o1 == o2 and a1 == a2
+
+
+def test_simple_bind_forward_backward():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    out = mx.sym.sum(fc)
+    ex = out.simple_bind(mx.cpu(), data=(2, 5))
+    ex.arg_dict["data"][:] = 1.0
+    ex.arg_dict["fc_weight"][:] = 0.5
+    ex.arg_dict["fc_bias"][:] = 0.0
+    outs = ex.forward(is_train=True)
+    np.testing.assert_allclose(outs[0].asnumpy(), 2 * 3 * 5 * 0.5, rtol=1e-5)
+    ex.backward()
+    # d out / d bias = 2 (batch size)
+    np.testing.assert_allclose(ex.grad_dict["fc_bias"].asnumpy(),
+                               np.full(3, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               np.full((3, 5), 2.0), rtol=1e-5)
+
+
+def test_executor_softmax_output_grad():
+    """SoftmaxOutput is a loss head: backward seeds (p - onehot)/..."""
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    out = mx.sym.SoftmaxOutput(data=data, label=label, name="sm")
+    ex = out.simple_bind(mx.cpu(), data=(2, 4), label=(2,),
+                         grad_req={"data": "write"})
+    x = np.random.randn(2, 4).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = np.array([1, 3], dtype=np.float32)
+    outs = ex.forward(is_train=True)
+    p = outs[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(2), rtol=1e-5)
+    ex.backward()
+    onehot = np.zeros((2, 4), np.float32)
+    onehot[0, 1] = 1
+    onehot[1, 3] = 1
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), p - onehot,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_executor_batchnorm_aux_update():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn", momentum=0.5)
+    loss = mx.sym.sum(bn)
+    ex = loss.simple_bind(mx.cpu(), data=(8, 3))
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.randn(8, 3).astype(np.float32) * 3 + 1
+    ex.arg_dict["data"][:] = x
+    mm_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    mm_after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expected = 0.5 * mm_before + 0.5 * x.mean(axis=0)
+    np.testing.assert_allclose(mm_after, expected, rtol=1e-4)
+    # predict mode must NOT touch the stats
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               mm_after, rtol=1e-6)
+
+
+def test_bind_with_arrays():
+    x = mx.sym.var("x")
+    y = x * 2 + 1
+    xv = mx.nd.array(np.arange(6).reshape(2, 3))
+    ex = y.bind(mx.cpu(), {"x": xv})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.arange(6).reshape(2, 3) * 2 + 1)
+
+
+def test_grad_req_add_and_null():
+    x = mx.sym.var("x")
+    y = mx.sym.sum(x * 3)
+    ex = y.simple_bind(mx.cpu(), x=(4,), grad_req="add")
+    ex.arg_dict["x"][:] = 1.0
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), np.full(4, 6.0))
+    ex2 = y.simple_bind(mx.cpu(), x=(4,), grad_req="null")
+    ex2.arg_dict["x"][:] = 1.0
+    ex2.forward(is_train=True)
+    ex2.backward()   # no-op
+    assert ex2.grad_dict == {}
+
+
+def test_slice_channel_multi_output():
+    x = mx.sym.var("x")
+    parts = mx.sym.SliceChannel(x, num_outputs=3, axis=1, name="split")
+    assert parts.num_outputs == 3
+    s = parts[0] + parts[1] + parts[2]
+    ex = s.simple_bind(mx.cpu(), x=(2, 6))
+    ex.arg_dict["x"][:] = 1.0
+    out = ex.forward()[0]
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_rnn_symbol_infer():
+    data = mx.sym.var("data")
+    rnn = mx.sym.RNN(data=data, state_size=16, num_layers=2, mode="lstm",
+                     name="lstm", state_outputs=True)
+    arg_shapes, out_shapes, _ = rnn.infer_shape(data=(10, 4, 8))
+    d = dict(zip(rnn.list_arguments(), arg_shapes))
+    assert out_shapes[0] == (10, 4, 16)
+    assert d["lstm_state"] == (2, 4, 16)
+    assert rnn.num_outputs == 3  # out, h, c
+
+
+def test_cached_op_forward():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    op = mx.CachedOp(net)
+    assert op.input_names == ["data", "fc_weight", "fc_bias"]
+    d = mx.nd.ones((2, 5))
+    w = mx.nd.full((3, 5), 0.5)
+    b = mx.nd.zeros((3,))
+    (out,) = op(d, w, b)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 2.5), rtol=1e-5)
+
+
+def test_cached_op_backward_through_tape():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    op = mx.CachedOp(net)
+    d = mx.nd.ones((2, 5))
+    w = mx.nd.full((3, 5), 0.5)
+    b = mx.nd.zeros((3,))
+    w.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        (out,) = op(d, w, b)
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(b.grad.asnumpy(), np.full(3, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(w.grad.asnumpy(), np.full((3, 5), 2.0),
+                               rtol=1e-5)
+
+
+def test_eval():
+    x = mx.sym.var("x")
+    y = x * 2
+    out = y.eval(x=mx.nd.ones((2, 2)))
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 2), 2.0))
+
+
+def test_dropout_rng_in_graph():
+    x = mx.sym.var("x")
+    y = mx.sym.Dropout(x, p=0.5)
+    ex = y.simple_bind(mx.cpu(), x=(100,))
+    ex.arg_dict["x"][:] = 1.0
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    assert (out_train == 0).any()
+    out_pred = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_pred, np.ones(100))
