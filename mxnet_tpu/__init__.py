@@ -32,6 +32,20 @@ from .symbol import Symbol
 from . import executor
 from .executor import Executor
 from .cached_op import CachedOp
+from . import initializer
+from .initializer import Xavier, Uniform, Normal  # noqa: F401
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from . import module
+from .module import Module
+from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

@@ -58,7 +58,6 @@ class CachedOp:
     def _bwd(self, mode):
         if mode not in self._bwd_jits:
             fn, _, _, _ = build_graph_fn(self._symbol._entries, mode)
-            arg_names = tuple(self._arg_names)
 
             def bwd(args, aux, key, cots):
                 def f(g):

@@ -51,6 +51,14 @@ class Executor:
         self._jits = {}         # (mode, fused) -> jitted fn
         self._needs_rng = None
         self._monitor_callback = None
+        # optional SPMD plan: name -> jax Sharding, enforced on every
+        # dispatch (the PlaceDevice-pass equivalent; set by the executor
+        # group when running over a device mesh)
+        self._shardings = None
+
+    def set_shardings(self, shardings):
+        self._shardings = dict(shardings) if shardings else None
+        self._jits = {}
 
     # ------------------------------------------------------------------
     # binding constructors (reference: MXExecutorSimpleBind / Bind)
@@ -179,6 +187,16 @@ class Executor:
         return jitted
 
     def _raw_inputs(self):
+        if self._shardings is not None:
+            sh = self._shardings
+            for n in self._arg_names:
+                a = self.arg_dict[n]
+                if n in sh:
+                    a._data = jax.device_put(a._data, sh[n])
+            for n in self._aux_names:
+                a = self.aux_dict[n]
+                if n in sh:
+                    a._data = jax.device_put(a._data, sh[n])
         args = {n: self.arg_dict[n]._data for n in self._arg_names}
         aux = {n: self.aux_dict[n]._data for n in self._aux_names}
         return args, aux
@@ -198,14 +216,18 @@ class Executor:
             tgt = self.arg_dict[k]
             tgt._data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
         args, aux = self._raw_inputs()
+        key = self._key()
         if is_train and self._grad_names:
             fused = self._get_jit("train", True)
-            outs, auxup, grads = fused(args, aux, self._key(), None)
-            self._cached = (args, aux, outs, grads)
+            outs, auxup, grads = fused(args, aux, key, None)
+            # cache the exact (args, aux, key) this forward used so a later
+            # backward(out_grads) replays the SAME computation (same
+            # dropout masks / RNG draws), not a fresh one
+            self._cached = (args, aux, key, grads)
         else:
             mode = "train" if is_train else "predict"
             fn = self._get_jit(mode, False)
-            outs, auxup = fn(args, aux, self._key())
+            outs, auxup = fn(args, aux, key)
             self._cached = None
         if is_train:
             for name, val in auxup.items():
@@ -222,14 +244,20 @@ class Executor:
         if out_grads is None and self._cached is not None:
             grads = self._cached[3]
         else:
-            args, aux = self._raw_inputs()
+            if self._cached is not None:
+                # reuse the forward's inputs AND its PRNG key so random ops
+                # (dropout) use identical masks in this replayed fwd+bwd
+                args, aux, key, _ = self._cached
+            else:
+                args, aux = self._raw_inputs()
+                key = self._key()
             if out_grads is not None:
                 if isinstance(out_grads, NDArray):
                     out_grads = [out_grads]
                 out_grads = [g._data if isinstance(g, NDArray)
                              else jnp.asarray(g) for g in out_grads]
             fused = self._get_jit("train", True)
-            _, _, grads = fused(args, aux, self._key(), out_grads)
+            _, _, grads = fused(args, aux, key, out_grads)
         for name, g in grads.items():
             buf = self.grad_dict.get(name)
             if buf is None:
@@ -264,10 +292,16 @@ class Executor:
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor for new input shapes. XLA recompiles per
         shape signature automatically (the bucketing cost model)."""
+        from .base import dtype_name
         known = dict(kwargs)
-        return Executor._simple_bind(
-            self._symbol, self._ctx,
-            grad_req=self._grad_req, shape_kwargs=known, shared_exec=self)
+        # preserve the bound dtypes of the reshaped inputs
+        type_dict = {n: dtype_name(self.arg_dict[n].dtype)
+                     for n in known if n in self.arg_dict}
+        ex = Executor._simple_bind(
+            self._symbol, self._ctx, grad_req=self._grad_req,
+            type_dict=type_dict, shape_kwargs=known, shared_exec=self)
+        ex._shardings = self._shardings
+        return ex
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback

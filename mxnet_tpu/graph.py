@@ -83,12 +83,32 @@ def topo_order(output_entries):
     return order
 
 
+def aux_var_ids(order):
+    """Variables consumed at aux input positions of some op IN THIS GRAPH.
+
+    Aux-ness is a property of usage within a graph, not of the variable
+    node itself — the same var symbol can be a plain argument in one graph
+    and a BatchNorm moving-stat in another (reference: aux states are
+    declared per-op by ListAuxiliaryStates, resolved per-graph)."""
+    aux = set()
+    for node in order:
+        if node.is_variable or not node.op.aux_write:
+            continue
+        for _, ii in node.op.aux_write.items():
+            in_node, _ = node.inputs[ii]
+            if in_node.is_variable:
+                aux.add(id(in_node))
+    return aux
+
+
 def collect_vars(output_entries):
     """Return (arg_nodes, aux_nodes) in first-seen topo order."""
+    order = topo_order(output_entries)
+    aux_ids = aux_var_ids(order)
     args, aux = [], []
-    for node in topo_order(output_entries):
+    for node in order:
         if node.is_variable:
-            (aux if node.is_aux else args).append(node)
+            (aux if id(node) in aux_ids else args).append(node)
     return args, aux
 
 
@@ -106,6 +126,7 @@ def build_graph_fn(output_entries, mode="predict"):
     in place; XLA state must be explicit).
     """
     order = topo_order(output_entries)
+    aux_ids = aux_var_ids(order)
     arg_nodes, aux_nodes = collect_vars(output_entries)
     arg_names = [n.name for n in arg_nodes]
     aux_names = [n.name for n in aux_nodes]
@@ -128,7 +149,7 @@ def build_graph_fn(output_entries, mode="predict"):
         aux_updates = {}
         for node in order:
             if node.is_variable:
-                if node.is_aux:
+                if id(node) in aux_ids:
                     values[id(node)] = (aux[node.name],)
                 else:
                     values[id(node)] = (args[node.name],)
@@ -149,7 +170,7 @@ def build_graph_fn(output_entries, mode="predict"):
             if op.aux_write and train:
                 for oi, ii in op.aux_write.items():
                     in_node, _ = node.inputs[ii]
-                    if in_node.is_variable and in_node.is_aux:
+                    if in_node.is_variable and id(in_node) in aux_ids:
                         aux_updates[in_node.name] = raw[oi]
         outs = [values[id(n)][i] for n, i in output_entries]
         return outs, aux_updates
@@ -393,8 +414,6 @@ def infer_structs(output_entries, known, mode="predict"):
                     var_structs[in_node.name] = s
                     out_structs[id(in_node)] = [s]
         if any(s is None for s in ins):
-            missing = [n.name for (n, _), s in zip(node.inputs, ins)
-                       if s is None]
             out_structs[id(node)] = [None] * node.n_raw()
             continue
         params = _reg.apply_defaults(node.op, node.params)

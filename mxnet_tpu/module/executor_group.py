@@ -1,0 +1,188 @@
+"""Data-parallel executor group.
+
+Reference: python/mxnet/module/executor_group.py:143
+(DataParallelExecutorGroup) — there, the batch is sliced across GPUs, one
+GraphExecutor is bound per device, and gradients are reduced by KVStore.
+
+TPU-native design: ONE executor over the GLOBAL batch. When multiple
+contexts are given, their devices form a `jax.sharding.Mesh` with a 'data'
+axis; data inputs are placed with NamedSharding(P('data')) and parameters
+replicated (P()). jax.jit then compiles a single SPMD program where XLA
+inserts the gradient all-reduce over ICI — subsuming the reference's
+slice/scatter/executor-per-GPU/KVStore-reduce machinery. The KVStore facade
+still sees per-"device" param/grad lists of length 1 (the mesh is one
+logical device).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _as_data_desc(x):
+    if isinstance(x, DataDesc):
+        return x
+    return DataDesc(x[0], x[1])
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = set(state_names or [])
+        self.logger = logger
+
+        self.data_shapes = [_as_data_desc(d) for d in data_shapes]
+        self.label_shapes = [_as_data_desc(l) for l in label_shapes] \
+            if label_shapes else []
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+        self.batch_size = self.data_shapes[0].shape[0]
+
+        arg_names = symbol.list_arguments()
+        self.arg_names = arg_names
+        self.aux_names = symbol.list_auxiliary_states()
+        input_names = set(self.data_names + self.label_names)
+
+        # grad_req per arg (reference: executor_group.py:213)
+        if isinstance(grad_req, str):
+            base_req = grad_req
+            req = {}
+            for name in arg_names:
+                if name in self.param_names:
+                    req[name] = "null" if (not for_training or
+                                           name in self.fixed_param_names) \
+                        else base_req
+                elif name in input_names:
+                    req[name] = base_req if (inputs_need_grad and
+                                             name in self.data_names) \
+                        else "null"
+                else:
+                    req[name] = "null"
+        else:
+            req = dict(grad_req)
+        self._grad_req = req
+
+        # device mesh over the given contexts (SPMD data axis)
+        self._mesh = None
+        self._data_sharding = None
+        self._repl_sharding = None
+        if len(contexts) > 1:
+            devices = [c.jax_device for c in contexts]
+            if self.batch_size % len(devices) != 0:
+                raise MXNetError(
+                    "batch size %d not divisible by %d devices"
+                    % (self.batch_size, len(devices)))
+            self._mesh = Mesh(np.array(devices), ("data",))
+            self._data_sharding = NamedSharding(self._mesh, P("data"))
+            self._repl_sharding = NamedSharding(self._mesh, P())
+
+        shapes = {d.name: d.shape for d in
+                  self.data_shapes + self.label_shapes}
+        shared_exec = shared_group.execs[0] if shared_group is not None \
+            else None
+        self.exec_ = symbol.simple_bind(
+            contexts[0], grad_req=req, shared_exec=shared_exec,
+            **shapes)
+        self.execs = [self.exec_]
+        if self._repl_sharding is not None:
+            # SPMD plan: data inputs split over the mesh's data axis,
+            # everything else replicated; the executor re-enforces this on
+            # every dispatch (kvstore/optimizer writes land on one device)
+            plan = {}
+            for name in arg_names:
+                plan[name] = self._data_sharding if name in input_names \
+                    else self._repl_sharding
+            for name in self.aux_names:
+                plan[name] = self._repl_sharding
+            self.exec_.set_shardings(plan)
+
+        # param/grad arrays: list over params of per-"device" lists (len 1)
+        self.param_arrays = [[self.exec_.arg_dict[n]] for n in
+                             self.param_names]
+        self.grad_arrays = [[self.exec_.grad_dict[n]]
+                            if n in self.exec_.grad_dict else [None]
+                            for n in self.param_names]
+        self.aux_arrays = [[self.exec_.aux_dict[n]] for n in self.aux_names]
+        self.slices = [slice(0, self.batch_size)]
+
+    # ------------------------------------------------------------------
+    def _place_input(self, name, value):
+        data = value._data if isinstance(value, NDArray) else jnp.asarray(value)
+        if self._data_sharding is not None:
+            data = jax.device_put(data, self._data_sharding)
+        tgt = self.exec_.arg_dict[name]
+        if tuple(data.shape) != tgt.shape:
+            raise MXNetError(
+                "input %r shape %s does not match bound shape %s (rebind "
+                "for a new batch size)" % (name, tuple(data.shape), tgt.shape))
+        tgt._data = data.astype(tgt.dtype) if data.dtype != tgt.dtype else data
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        for name, value in zip(self.data_names, data_batch.data):
+            self._place_input(name, value)
+        if self.label_names and data_batch.label:
+            for name, value in zip(self.label_names, data_batch.label):
+                self._place_input(name, value)
+        self.exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        self.exec_.backward(out_grads=out_grads)
+
+    # ------------------------------------------------------------------
+    def get_outputs(self, merge_multi_context=True, begin=0, end=None):
+        outs = self.exec_.outputs
+        if end is not None or begin:
+            outs = outs[begin:end]
+        return outs if merge_multi_context else [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [self.exec_.grad_dict.get(n) for n in self.data_names]
+        return grads if merge_multi_context else [[g] for g in grads]
+
+    def get_params(self, arg_params, aux_params):
+        for name in self.param_names:
+            arg_params[name]._data = self.exec_.arg_dict[name]._data
+        for name in self.aux_names:
+            aux_params[name]._data = self.exec_.aux_dict[name]._data
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self.exec_.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=allow_extra)
+        if self._repl_sharding is not None:
+            for name in self.param_names:
+                arr = self.exec_.arg_dict[name]
+                arr._data = jax.device_put(arr._data, self._repl_sharding)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        labels_ = labels
+        if pre_sliced:
+            labels_ = labels[0]
+        eval_metric.update_dict(
+            dict(zip(self.label_names, labels_)),
+            dict(zip(self.symbol.list_outputs(), self.exec_.outputs)))
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

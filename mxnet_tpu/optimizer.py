@@ -1,0 +1,673 @@
+"""Optimizer zoo.
+
+Reference: python/mxnet/optimizer.py (registry :35,112; SGD :445, Signum
+:550, NAG :906, SGLD, Adam :994, AdaGrad :1076, RMSProp :1128, AdaDelta,
+Ftrl, Adamax, Nadam, FTML, DCASGD) and the fused C++ update kernels in
+src/operator/optimizer_op.cc.
+
+TPU-native design: every update rule is a pure jax function jit-compiled
+once per (rule, hyperparam, shape/dtype) signature — the analog of the
+reference's fused sgd_update/adam_update kernels, except XLA also fuses
+weight-decay/clip/rescale into the same kernel. Multi-precision (fp32
+master weights for bf16/fp16 params) mirrors the reference's
+multi_precision flag.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "FTML",
+           "DCASGD", "LBSGD", "Test", "Updater", "get_updater", "create",
+           "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:35)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry -------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError("cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    # -- state ----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (np.float16,
+                                                     np.dtype("bfloat16")):
+            weight_master_copy = NDArray(weight._data.astype(jnp.float32))
+            return (weight_master_copy, self.create_state(index,
+                                                          weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                isinstance(state[0], NDArray):
+            master, base_state = state
+            g32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, g32, base_state)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr/wd plumbing (reference: optimizer.py:160-260) ----------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        return d
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _prep(grad, rescale, clip, wd, weight):
+    """Common gradient preprocessing, fused by XLA into the update."""
+    g = grad * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    if wd:
+        g = g + wd * weight
+    return g
+
+
+# Each kernel is jitted per hyper-param + shape signature (scalars passed
+# as traced args would defeat constant folding for schedules; lr changes
+# per step, so lr IS a traced arg while wd/clip/momentum are static).
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _sgd_kernel(weight, grad, lr, rescale, clip, wd, momentum, mom=None):
+    g = _prep(grad, rescale, clip, wd, weight)
+    if momentum:
+        mom = momentum * mom - lr * g
+        return weight + mom, mom
+    return weight - lr * g, None
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision
+    (reference: optimizer.py:445, fused kernel optimizer_op.cc sgd_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if getattr(grad, "stype", "default") == "row_sparse":
+            grad = grad.tostype("default")
+        new_w, new_m = _sgd_kernel(
+            weight._data, grad._data, lr, self.rescale_grad,
+            self.clip_gradient, wd, self.momentum,
+            state._data if state is not None else jnp.zeros((), weight._data.dtype))
+        weight._data = new_w
+        if state is not None and new_m is not None:
+            state._data = new_m
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: optimizer.py:906)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        if state is not None:
+            m = state._data
+            m = self.momentum * m + g
+            g = g + self.momentum * m
+            state._data = m
+        weight._data = weight._data - lr * g
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference: optimizer.py:550)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            m = self.momentum * state._data - (1 - self.momentum) * (
+                g + wd * weight._data)
+            state._data = m
+            d = jnp.sign(m)
+            weight._data = (1 - lr * self.wd_lh) * weight._data + lr * d
+        else:
+            weight._data = (1 - lr * (wd + self.wd_lh)) * weight._data \
+                - lr * jnp.sign(g)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py)."""
+
+    def update(self, index, weight, grad, state):
+        from . import random as _random
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * g + noise
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
+def _adam_kernel(weight, grad, mean, var, lr, beta1, beta2, epsilon,
+                 rescale, clip, wd, t=1):
+    g = _prep(grad, rescale, clip, wd, weight)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * (coef2 ** 0.5) / coef1
+    w = weight - lr_t * mean / (jnp.sqrt(var) + epsilon)
+    return w, mean, var
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py:994, adam_update optimizer_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        w, m, v = _adam_kernel(weight._data, grad._data, mean._data,
+                               var._data, lr, self.beta1, self.beta2,
+                               self.epsilon, self.rescale_grad,
+                               self.clip_gradient, wd, t)
+        weight._data = w
+        mean._data = m
+        var._data = v
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py:1076)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        hist = state._data + jnp.square(g)
+        state._data = hist
+        weight._data = weight._data - lr * g / (
+            jnp.sqrt(hist) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered + vanilla (reference: optimizer.py:1128)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)))
+        return (NDArray(jnp.zeros_like(weight._data)),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        if self.centered:
+            n, gm, delta = state
+            n_ = self.gamma1 * n._data + (1 - self.gamma1) * jnp.square(g)
+            gm_ = self.gamma1 * gm._data + (1 - self.gamma1) * g
+            d_ = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n_ - jnp.square(gm_) + self.epsilon)
+            n._data, gm._data, delta._data = n_, gm_, d_
+            w = weight._data + d_
+        else:
+            (n,) = state
+            n_ = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
+            n._data = n_
+            w = weight._data - lr * g / jnp.sqrt(n_ + self.epsilon)
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        weight._data = w
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(
+            ag + self.epsilon) * g
+        ad = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        acc_g._data, acc_delta._data = ag, ad
+        weight._data = weight._data - delta
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: optimizer.py, ftrl_update optimizer_op.cc)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),   # z
+                NDArray(jnp.zeros_like(weight._data)))   # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + jnp.square(g)) - jnp.sqrt(n._data)) / lr
+        z_ = z._data + g - sigma * weight._data
+        n_ = n._data + jnp.square(g)
+        z._data, n._data = z_, n_
+        weight._data = jnp.where(
+            jnp.abs(z_) <= self.lamda1,
+            jnp.zeros_like(z_),
+            (jnp.sign(z_) * self.lamda1 - z_)
+            / ((self.beta + jnp.sqrt(n_)) / lr + wd))
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference: optimizer.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        m, u = state
+        m_ = self.beta1 * m._data + (1 - self.beta1) * g
+        u_ = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        m._data, u._data = m_, u_
+        weight._data = weight._data - lr * m_ / (u_ + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        g_prime = g / (1.0 - self.m_schedule)
+        m_ = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v_ = self.beta2 * v._data + (1.0 - self.beta2) * jnp.square(g)
+        m_prime = m_ / (1.0 - m_schedule_next)
+        v_prime = v_ / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        m._data, v._data = m_, v_
+        weight._data = weight._data - lr * m_bar / (
+            jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class FTML(Optimizer):
+    """FTML (reference: optimizer.py FTML)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),   # d
+                NDArray(jnp.zeros_like(weight._data)),   # v
+                NDArray(jnp.zeros_like(weight._data)))   # z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        d, v, z = state
+        v_ = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        d_ = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v_ / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_ - self.beta1 * d._data
+        z_ = self.beta1 * z._data + (1 - self.beta1) * g - sigma * weight._data
+        d._data, v._data, z._data = d_, v_, z_
+        weight._data = -z_ / d_
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:850)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, NDArray(weight._data))
+        return (NDArray(jnp.zeros_like(weight._data)), NDArray(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            m = self.momentum * mom._data - lr * (comp + wd * weight._data)
+            mom._data = m
+            step = m
+        else:
+            step = -lr * (comp + wd * weight._data)
+        prev._data = weight._data
+        weight._data = weight._data + step
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (reference: optimizer.py:660)."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+
+    def update(self, index, weight, grad, state):
+        # LARS trust ratio
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
+                  weight._data)
+        wnorm = jnp.linalg.norm(weight._data)
+        gnorm = jnp.linalg.norm(g)
+        trust = jnp.where(gnorm > 0, wnorm / (gnorm + 1e-9), 1.0)
+        trust = jnp.clip(trust, 0.0, 50.0)
+        lr_eff = lr * trust
+        if state is not None:
+            m = self.momentum * state._data - lr_eff * g
+            state._data = m
+            weight._data = weight._data + m
+        else:
+            weight._data = weight._data - lr_eff * g
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by unit tests (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+
+
+# shorthand aliases the reference exposes
+ccSGD = SGD
+Optimizer.opt_registry["ccsgd"] = SGD
+
+
+class Updater:
+    """Applies an optimizer keyed by parameter index (reference:
+    optimizer.py get_updater / Updater — also what kvstore servers run)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -77,13 +77,25 @@ def make_symbol_func(op, name):
         else:
             spec = list(op.input_names)
         if spec is not None:
+            if len(inputs) > len(spec):
+                raise MXNetError(
+                    "sym.%s: got %d positional inputs but the op takes at "
+                    "most %d (%s)" % (name, len(inputs), len(spec), spec))
             # fill positional, then named, leave rest to auto-vars
             slots = list(inputs) + [None] * (len(spec) - len(inputs))
             for k, v in named_inputs.items():
-                if k not in spec:
-                    raise MXNetError("sym.%s: unknown input %r (inputs: %s)"
-                                     % (name, k, spec))
-                slots[spec.index(k)] = v
+                if k in spec:
+                    slots[spec.index(k)] = v
+                    continue
+                # mxnet-style aliases: 'data' (or any unknown input kwarg)
+                # fills the first free slot — op fns name inputs 'x'/'a'
+                # while the reference API spells them 'data'/'lhs'
+                free = [i for i, s in enumerate(slots) if s is None]
+                if not free:
+                    raise MXNetError(
+                        "sym.%s: unknown input %r (inputs: %s)"
+                        % (name, k, spec))
+                slots[free[0]] = v
             inputs = slots[:len(spec)]
         else:
             inputs = inputs + list(named_inputs.values())

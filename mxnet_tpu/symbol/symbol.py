@@ -392,8 +392,8 @@ class Symbol:
             else:
                 lines.append("--------------------")
                 lines.append("Op:%s, Name=%s" % (node.op.name, node.name))
-                for n, i in node.inputs:
-                    lines.append("\targ[%d]=%s(%d)" % (i, n.name, i))
+                for pos, (n, i) in enumerate(node.inputs):
+                    lines.append("\targ[%d]=%s(%d)" % (pos, n.name, i))
         return "\n".join(lines)
 
 
@@ -427,12 +427,10 @@ def _apply_op(op, input_syms, params, name, aux_indices=(),
                 inputs.append((v, 0))
     else:
         inputs = [_entry_of(s) for s in input_syms]
-    # mark aux-position variables
-    for i in aux_indices:
-        if i < len(inputs):
-            n = inputs[i][0]
-            if n.is_variable:
-                n.is_aux = True
+    # NOTE: aux-ness (BatchNorm moving stats etc.) is NOT stamped on the
+    # variable nodes — it is derived per-graph from usage at aux input
+    # positions (graph.aux_var_ids), so sharing a var between graphs can't
+    # reclassify it elsewhere.
     node = Node(op, inputs, params, name)
     return Symbol([(node, i) for i in range(node.n_visible())])
 

@@ -1,0 +1,194 @@
+"""Optimizer / initializer / lr_scheduler / metric tests.
+
+Mirrors the reference's tests/python/unittest/test_optimizer.py,
+test_init.py, test_metric.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import metric as metric_mod
+
+
+def quad_loss_weights():
+    w = mx.nd.array(np.array([3.0, -2.0, 5.0], np.float32))
+    return w
+
+
+def run_steps(optimizer, steps=60):
+    """Minimize ||w||^2 — gradient is 2w."""
+    w = quad_loss_weights()
+    state = optimizer.create_state(0, w)
+    for _ in range(steps):
+        g = w * 2.0
+        optimizer.update(0, w, g, state)
+    return w.asnumpy()
+
+
+@pytest.mark.parametrize("name,kwargs,tol", [
+    ("sgd", dict(learning_rate=0.1), 1.0),
+    ("sgd", dict(learning_rate=0.1, momentum=0.9), 1.0),
+    ("nag", dict(learning_rate=0.05, momentum=0.9), 1.0),
+    ("adam", dict(learning_rate=0.3), 1.0),
+    ("adagrad", dict(learning_rate=1.0), 1.0),
+    ("rmsprop", dict(learning_rate=0.1), 1.0),
+    ("rmsprop", dict(learning_rate=0.1, centered=True), 1.0),
+    ("adadelta", dict(rho=0.9), 4.5),   # tiny effective lr ~ sqrt(eps)
+    ("ftrl", dict(learning_rate=1.0), 1.0),
+    ("adamax", dict(learning_rate=0.3), 1.0),
+    ("nadam", dict(learning_rate=0.3), 1.0),
+    ("signum", dict(learning_rate=0.05), 1.0),
+    ("ftml", dict(learning_rate=0.3), 1.0),
+])
+def test_optimizer_converges(name, kwargs, tol):
+    o = opt.create(name, **kwargs)
+    w = run_steps(o, steps=150)
+    assert np.abs(w).max() < tol, "%s did not reduce ||w||: %r" % (name, w)
+
+
+def test_sgd_momentum_matches_manual():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    w = mx.nd.array(np.array([1.0], np.float32))
+    state = o.create_state(0, w)
+    wv, mom = 1.0, 0.0
+    for _ in range(5):
+        g = 2 * wv
+        o.update(0, w, mx.nd.array(np.array([g], np.float32)), state)
+        mom = 0.9 * mom - 0.1 * g
+        wv = wv + mom
+        np.testing.assert_allclose(w.asnumpy(), [wv], rtol=1e-5)
+
+
+def test_weight_decay_and_clip():
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1,
+                   clip_gradient=0.5, param_idx2name={0: "w_weight"})
+    w = mx.nd.array(np.array([1.0], np.float32))
+    state = o.create_state(0, w)
+    o.update(0, w, mx.nd.array(np.array([10.0], np.float32)), state)
+    # grad clipped to 0.5, wd adds 0.1*1.0 -> step = 0.1*0.6
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - 0.1 * 0.6], rtol=1e-5)
+
+
+def test_multi_precision():
+    o = opt.create("sgd", learning_rate=0.1, multi_precision=True)
+    w = mx.nd.array(np.ones(4), dtype="float16")
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple)
+    g = mx.nd.array(np.full(4, 1e-4), dtype="float16")
+    for _ in range(10):
+        o.update_multi_precision(0, w, g, state)
+    master = state[0].asnumpy()
+    np.testing.assert_allclose(master, np.ones(4) - 10 * 0.1 * 1e-4,
+                               rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert abs(s(11) - 0.5) < 1e-9
+    assert abs(s(21) - 0.25) < 1e-9
+
+
+def test_lr_scheduler_multifactor():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                             base_lr=1.0)
+    assert s(2) == 1.0
+    assert abs(s(6) - 0.1) < 1e-9
+    assert abs(s(11) - 0.01) < 1e-9
+
+
+def test_lr_warmup():
+    s = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                      warmup_steps=10)
+    assert s(0) == 0.0
+    assert s(5) == 0.5
+    assert s(10) == pytest.approx(1.0, rel=1e-6)
+
+
+def test_updater():
+    o = opt.create("sgd", learning_rate=0.1)
+    upd = opt.get_updater(o)
+    w = mx.nd.array(np.array([2.0], np.float32))
+    upd(0, mx.nd.array(np.array([1.0], np.float32)), w)
+    np.testing.assert_allclose(w.asnumpy(), [1.9], rtol=1e-6)
+    states = upd.get_states()
+    upd2 = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+    upd2.set_states(states)
+
+
+def test_initializers():
+    from mxnet_tpu import initializer as init
+    for i, check in [
+        (init.Zero(), lambda a: np.all(a == 0)),
+        (init.One(), lambda a: np.all(a == 1)),
+        (init.Constant(3.5), lambda a: np.all(a == 3.5)),
+        (init.Uniform(0.1), lambda a: np.abs(a).max() <= 0.1),
+        (init.Normal(0.01), lambda a: np.abs(a).mean() < 0.1),
+        (init.Xavier(), lambda a: np.isfinite(a).all()),
+        (init.MSRAPrelu(), lambda a: np.isfinite(a).all()),
+    ]:
+        arr = mx.nd.zeros((16, 32)) + 99
+        i("test_weight", arr)
+        assert check(arr.asnumpy()), type(i)
+
+
+def test_initializer_suffix_dispatch():
+    from mxnet_tpu import initializer as init
+    x = init.Xavier()
+    g = mx.nd.zeros((8,)) + 5
+    x("bn_gamma", g)
+    np.testing.assert_allclose(g.asnumpy(), np.ones(8))
+    b = mx.nd.zeros((8,)) + 5
+    x("fc_bias", b)
+    np.testing.assert_allclose(b.asnumpy(), np.zeros(8))
+    mm = mx.nd.zeros((8,)) + 5
+    x("bn_moving_mean", mm)
+    np.testing.assert_allclose(mm.asnumpy(), np.zeros(8))
+    mv = mx.nd.zeros((8,)) + 5
+    x("bn_moving_var", mv)
+    np.testing.assert_allclose(mv.asnumpy(), np.ones(8))
+
+
+def test_orthogonal_initializer():
+    from mxnet_tpu import initializer as init
+    arr = mx.nd.zeros((16, 16))
+    init.Orthogonal(scale=1.0)("q_weight", arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(16), atol=1e-5)
+
+
+def test_metric_accuracy():
+    m = metric_mod.create("acc")
+    pred = mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = mx.nd.array(np.array([1, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5
+
+
+def test_metric_topk():
+    m = metric_mod.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array(np.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]],
+                                np.float32))
+    label = mx.nd.array(np.array([2, 2], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5
+
+
+def test_metric_mse_perplexity():
+    m = metric_mod.create("mse")
+    m.update([mx.nd.array(np.zeros((4, 1)))],
+             [mx.nd.array(np.full((4, 1), 2.0))])
+    assert m.get()[1] == pytest.approx(4.0)
+    p = metric_mod.create("Perplexity", ignore_label=None)
+    pred = mx.nd.array(np.full((2, 4), 0.25))
+    label = mx.nd.array(np.array([0, 3], np.float32))
+    p.update([label], [pred])
+    assert p.get()[1] == pytest.approx(4.0, rel=1e-4)
+
+
+def test_metric_composite_and_custom():
+    c = metric_mod.create(["acc", "mse"])
+    names, values = None, None
+    custom = metric_mod.np(lambda label, pred: float(np.sum(label == label)))
+    assert custom.name.startswith("custom") or custom.name == "<lambda>"

@@ -246,3 +246,39 @@ def test_dropout_rng_in_graph():
     assert (out_train == 0).any()
     out_pred = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(out_pred, np.ones(100))
+
+
+def test_backward_out_grads_same_dropout_mask():
+    """backward(out_grads) must replay the SAME dropout mask as forward
+    (regression: fresh PRNG key made grads disagree with outputs)."""
+    x = mx.sym.var("x")
+    y = mx.sym.Dropout(x, p=0.5)
+    ex = y.simple_bind(mx.cpu(), x=(200,))
+    ex.arg_dict["x"][:] = 1.0
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward(out_grads=mx.nd.ones((200,)))
+    g = ex.grad_dict["x"].asnumpy()
+    kept = out != 0
+    np.testing.assert_allclose(g[kept], np.full(kept.sum(), 2.0))
+    np.testing.assert_allclose(g[~kept], 0.0)
+
+
+def test_shared_var_not_reclassified_as_aux():
+    """A var used as a BatchNorm moving stat in one graph stays a plain
+    argument in an unrelated graph (regression: global is_aux mutation)."""
+    mm = mx.sym.var("mm")
+    other = mm * 2
+    assert other.list_arguments() == ["mm"]
+    d = mx.sym.var("d")
+    bn = mx.sym.BatchNorm(data=d, moving_mean=mm, name="bn")
+    assert "mm" in bn.list_auxiliary_states()
+    assert other.list_arguments() == ["mm"]
+    assert other.list_auxiliary_states() == []
+
+
+def test_extra_positional_inputs_raise():
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    with pytest.raises(mx.MXNetError):
+        mx.sym.FullyConnected(x, w, b, num_hidden=3, no_bias=True)
