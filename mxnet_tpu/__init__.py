@@ -44,8 +44,11 @@ from . import kvstore
 from . import kvstore as kv
 from . import model
 from . import module
+from . import module as mod
 from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
+from . import recordio
+from . import gluon
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

@@ -1,0 +1,22 @@
+"""Gluon: the imperative/hybrid frontend (reference: python/mxnet/gluon/).
+
+Define-by-run Blocks with optional hybridize() tracing into one XLA
+computation; Parameter/Trainer for training; nn/rnn layer catalogs; data
+pipeline; model zoo.
+"""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import trainer
+from .trainer import Trainer
+from . import utils
+from . import nn
+from . import loss
+from . import rnn
+from . import data
+from . import model_zoo
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "data",
+           "model_zoo", "utils"]

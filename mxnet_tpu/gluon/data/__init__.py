@@ -1,0 +1,9 @@
+"""Gluon data pipeline (reference: python/mxnet/gluon/data/)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision
+
+from . import dataset
+from . import sampler
+from . import dataloader

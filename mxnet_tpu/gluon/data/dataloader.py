@@ -1,0 +1,156 @@
+"""Gluon DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py (DataLoader, worker_loop
+:152, shared-memory Queue :96, default_batchify_fn).
+
+TPU-native notes: the reference forks multiprocessing workers that pickle
+NDArrays through POSIX shared memory (cpu_shared_storage_manager.h). Here
+workers produce *numpy* batches (host memory) and the main process does a
+single host→device transfer per batch — the TPU-correct split, since only
+the host runtime may touch the device. num_workers>0 uses a
+multiprocessing.Pool the same way the reference does.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import pickle
+
+import numpy as np
+
+from ... import ndarray
+from ...ndarray import NDArray
+from . import sampler as _sampler
+
+__all__ = ["DataLoader"]
+
+
+def default_batchify_fn(data):
+    """Collate samples into a batch (reference: dataloader.py:126)."""
+    if isinstance(data[0], NDArray):
+        return ndarray.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return ndarray.array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    """Collate in a worker process: keep results in host numpy
+    (reference: dataloader.py:137 builds shm NDArrays)."""
+    if isinstance(data[0], NDArray):
+        return np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return np.asarray(data)
+
+
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_initializer(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(samples):
+    """Runs in a worker process (reference: dataloader.py:152
+    worker_loop)."""
+    batch = _worker_batchify([_worker_dataset[i] for i in samples])
+    return pickle.dumps(batch, pickle.HIGHEST_PROTOCOL)
+
+
+def _as_nd(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_as_nd(b) for b in batch]
+    if isinstance(batch, NDArray):
+        return batch
+    return ndarray.array(batch, dtype=batch.dtype)
+
+
+class DataLoader:
+    """Loads data from a Dataset and returns mini-batches
+    (reference: dataloader.py:210)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = _sampler.RandomSampler(len(dataset))
+                else:
+                    sampler = _sampler.SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = _sampler.BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(
+            0, int(prefetch) if prefetch is not None else
+            2 * self._num_workers)
+        if batchify_fn is None:
+            if num_workers > 0:
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            self._pool = multiprocessing.get_context("fork").Pool(
+                self._num_workers,
+                initializer=_worker_initializer,
+                initargs=(self._dataset, self._batchify_fn))
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield _as_nd(self._batchify_fn(
+                    [self._dataset[idx] for idx in batch]))
+            return
+
+        # async prefetch pipeline through the worker pool
+        pending = []
+        it = iter(self._batch_sampler)
+        for _ in range(self._prefetch + 1):
+            try:
+                pending.append(
+                    self._pool.apply_async(_worker_fn, (next(it),)))
+            except StopIteration:
+                break
+        while pending:
+            res = pending.pop(0)
+            batch = pickle.loads(res.get())
+            try:
+                pending.append(
+                    self._pool.apply_async(_worker_fn, (next(it),)))
+            except StopIteration:
+                pass
+            yield _as_nd(batch)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

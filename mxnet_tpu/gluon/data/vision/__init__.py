@@ -1,0 +1,6 @@
+"""Gluon vision datasets and transforms
+(reference: python/mxnet/gluon/data/vision/)."""
+from .datasets import *
+from . import transforms
+
+from . import datasets

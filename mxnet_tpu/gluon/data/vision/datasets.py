@@ -1,0 +1,240 @@
+"""Gluon vision datasets.
+
+Reference: python/mxnet/gluon/data/vision/datasets.py (MNIST, FashionMNIST,
+CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset).
+
+No-egress note: the reference downloads from S3; here `root` must already
+contain the standard files (same names/formats), otherwise a clear error
+is raised. Formats are identical so datasets fetched for the reference
+work unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import warnings
+
+import numpy as np
+
+from .... import ndarray
+from ..dataset import Dataset, ArrayDataset
+from ..dataset import RecordFileDataset
+from .... import recordio
+from ....recordio import unpack_img
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    """Base for on-disk datasets (reference: vision/datasets.py:43)."""
+
+    def __init__(self, root, transform):
+        super().__init__()
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST handwritten digits (reference: vision/datasets.py:70).
+
+    Expects the standard idx-format files (train-images-idx3-ubyte.gz
+    etc.) in `root`."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte.gz",)
+        self._train_label = ("train-labels-idx1-ubyte.gz",)
+        self._test_data = ("t10k-images-idx3-ubyte.gz",)
+        self._test_label = ("t10k-labels-idx1-ubyte.gz",)
+        self._namespace = "mnist"
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        if self._train:
+            data_file = self._train_data[0]
+            label_file = self._train_label[0]
+        else:
+            data_file = self._test_data[0]
+            label_file = self._test_label[0]
+        data_path = os.path.join(self._root, data_file)
+        label_path = os.path.join(self._root, label_file)
+        for p in (data_path, label_path):
+            if not os.path.exists(p) and not os.path.exists(p[:-3]):
+                raise RuntimeError(
+                    "%s not found. This environment has no network egress; "
+                    "place the standard MNIST files under %s." % (
+                        p, self._root))
+
+        def _open(path):
+            if os.path.exists(path):
+                return gzip.open(path, "rb")
+            return open(path[:-3], "rb")
+
+        with _open(label_path) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8) \
+                .astype(np.int32)
+        with _open(data_path) as fin:
+            struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), 28, 28, 1)
+        self._label = label
+        self._data = ndarray.array(data, dtype=np.uint8)
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST clothing dataset (reference: vision/datasets.py:123)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+        self._namespace = "fashion-mnist"
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 image dataset (reference: vision/datasets.py:171).
+
+    Expects the cifar-10 binary batches (data_batch_1.bin ...) in
+    `root`."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        self._archive_file_name = "cifar-10-binary.tar.gz"
+        self._train_data = ["data_batch_%d.bin" % i for i in range(1, 6)]
+        self._test_data = ["test_batch.bin"]
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(
+                -1, 3072 + 1)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        files = self._train_data if self._train else self._test_data
+        paths = [os.path.join(self._root, f) for f in files]
+        # also look inside an extracted cifar-10-batches-bin/ dir
+        alt = os.path.join(self._root, "cifar-10-batches-bin")
+        paths = [p if os.path.exists(p)
+                 else os.path.join(alt, os.path.basename(p)) for p in paths]
+        for p in paths:
+            if not os.path.exists(p):
+                raise RuntimeError(
+                    "%s not found. This environment has no network egress; "
+                    "place the CIFAR-10 binary files under %s." % (
+                        p, self._root))
+        data, label = zip(*[self._read_batch(p) for p in paths])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = ndarray.array(data, dtype=np.uint8)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 image dataset (reference: vision/datasets.py:226)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+        self._train_data = ["train.bin"]
+        self._test_data = ["test.bin"]
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(
+                -1, 3072 + 2)
+        return data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0 + self._fine_label].astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Image dataset over a RecordIO file
+    (reference: vision/datasets.py:269)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, self._flag)
+        img = ndarray.array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """Images stored as root/class/xxx.jpg
+    (reference: vision/datasets.py:303)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                warnings.warn("Ignoring %s, which is not a directory."
+                              % path, stacklevel=3)
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    warnings.warn(
+                        "Ignoring %s of type %s. Only support %s" % (
+                            filename, ext, ", ".join(self._exts)))
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img = np.asarray(Image.open(self.items[idx][0]).convert(
+            "RGB" if self._flag else "L"))
+        img = ndarray.array(img)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

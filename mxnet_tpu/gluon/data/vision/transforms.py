@@ -1,0 +1,276 @@
+"""Gluon vision transforms.
+
+Reference: python/mxnet/gluon/data/vision/transforms.py (Compose, Cast,
+ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop, RandomFlip*,
+RandomBrightness/Contrast/Saturation/Hue/ColorJitter, RandomLighting).
+
+TPU note: transforms run on host numpy inside DataLoader workers (the
+reference runs them on CPU too); the device sees only the collated batch.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from .... import ndarray
+from ....ndarray import NDArray
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomColorJitter", "RandomLighting"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially composes transforms
+    (reference: transforms.py:33)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            elif len(hybrid) == 1:
+                self.add(hybrid[0])
+                hybrid = []
+            elif len(hybrid) > 1:
+                hblock = HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                self.add(hblock)
+                hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    """Casts input to a specific dtype (reference: transforms.py:70)."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1)
+    (reference: transforms.py:88)."""
+
+    def hybrid_forward(self, F, x):
+        return F.transpose(F.cast(x, dtype="float32"),
+                           axes=(2, 0, 1)) / 255.0
+
+
+class Normalize(Block):
+    """Normalizes CHW tensor with mean and std
+    (reference: transforms.py:111)."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        return (x - ndarray.array(self._mean)) / ndarray.array(self._std)
+
+
+class _HostTransform(Block):
+    """Base for host-side (numpy) random transforms."""
+
+    def forward(self, x):
+        return ndarray.array(self._apply(_to_np(x)))
+
+    def _apply(self, img):
+        raise NotImplementedError
+
+
+class Resize(_HostTransform):
+    """Resize to a given size (reference: transforms.py:139)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def _apply(self, img):
+        h, w = img.shape[:2]
+        if isinstance(self._size, int):
+            if self._keep:
+                if h < w:
+                    nh, nw = self._size, int(w * self._size / h)
+                else:
+                    nh, nw = int(h * self._size / w), self._size
+            else:
+                nh = nw = self._size
+        else:
+            nw, nh = self._size
+        try:
+            from PIL import Image
+            out = np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
+                (nw, nh), Image.BILINEAR))
+            return out if out.ndim == 3 else out[:, :, None]
+        except ImportError:
+            import jax
+            return np.asarray(jax.image.resize(
+                img.astype(np.float32), (nh, nw) + img.shape[2:],
+                method="linear")).astype(img.dtype)
+
+
+class CenterCrop(_HostTransform):
+    """Crops the center of the image (reference: transforms.py:268)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def _apply(self, img):
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        return img[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomResizedCrop(_HostTransform):
+    """Random crop + resize (reference: transforms.py:220)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def _apply(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self._scale) * area
+            aspect = random.uniform(*self._ratio)
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = random.randint(0, w - cw)
+                y0 = random.randint(0, h - ch)
+                crop = img[y0:y0 + ch, x0:x0 + cw]
+                return Resize(self._size)._apply(crop)
+        return Resize(self._size)._apply(img)
+
+
+class RandomFlipLeftRight(_HostTransform):
+    """Random horizontal flip (reference: transforms.py:301)."""
+
+    def _apply(self, img):
+        if random.random() < 0.5:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomFlipTopBottom(_HostTransform):
+    """Random vertical flip (reference: transforms.py:312)."""
+
+    def _apply(self, img):
+        if random.random() < 0.5:
+            return img[::-1].copy()
+        return img
+
+
+class RandomBrightness(_HostTransform):
+    """Random brightness jitter (reference: transforms.py:323)."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = max(0, 1 - brightness), 1 + brightness
+
+    def _apply(self, img):
+        alpha = random.uniform(*self._args)
+        return np.clip(img.astype(np.float32) * alpha, 0,
+                       255 if img.dtype == np.uint8 else np.inf) \
+            .astype(img.dtype)
+
+
+class RandomContrast(_HostTransform):
+    """Random contrast jitter (reference: transforms.py:340)."""
+
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = max(0, 1 - contrast), 1 + contrast
+
+    def _apply(self, img):
+        alpha = random.uniform(*self._args)
+        x = img.astype(np.float32)
+        gray = x.mean()
+        out = gray + alpha * (x - gray)
+        return np.clip(out, 0, 255 if img.dtype == np.uint8 else np.inf) \
+            .astype(img.dtype)
+
+
+class RandomSaturation(_HostTransform):
+    """Random saturation jitter (reference: transforms.py:357)."""
+
+    def __init__(self, saturation):
+        super().__init__()
+        self._args = max(0, 1 - saturation), 1 + saturation
+
+    def _apply(self, img):
+        alpha = random.uniform(*self._args)
+        x = img.astype(np.float32)
+        gray = x.mean(axis=2, keepdims=True)
+        out = gray + alpha * (x - gray)
+        return np.clip(out, 0, 255 if img.dtype == np.uint8 else np.inf) \
+            .astype(img.dtype)
+
+
+class RandomColorJitter(_HostTransform):
+    """Random brightness/contrast/saturation jitter
+    (reference: transforms.py:391)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def _apply(self, img):
+        ts = list(self._ts)
+        random.shuffle(ts)
+        for t in ts:
+            img = t._apply(img)
+        return img
+
+
+class RandomLighting(_HostTransform):
+    """AlexNet-style PCA noise (reference: transforms.py:415)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def _apply(self, img):
+        alpha = np.random.normal(0, self._alpha, size=(3,)) \
+            .astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        out = img.astype(np.float32) + rgb
+        return np.clip(out, 0, 255 if img.dtype == np.uint8 else np.inf) \
+            .astype(img.dtype)

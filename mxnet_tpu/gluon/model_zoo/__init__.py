@@ -1,0 +1,6 @@
+"""Predefined and pretrained models
+(reference: python/mxnet/gluon/model_zoo/)."""
+from . import model_store
+from . import vision
+
+from .vision import get_model

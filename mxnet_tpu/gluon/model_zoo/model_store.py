@@ -1,0 +1,41 @@
+"""Pretrained model weight store.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py (get_model_file,
+purge). The reference downloads sha1-pinned .params from S3; this
+environment has no egress, so get_model_file only resolves files already
+present under `root` (same `<name>-<sha1[:8]>.params` or `<name>.params`
+naming), raising a clear error otherwise.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Locate a pretrained parameter file on disk
+    (reference: model_store.py:68)."""
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet",
+                                                   "models"))
+    if os.path.isdir(root):
+        exact = os.path.join(root, "%s.params" % name)
+        if os.path.exists(exact):
+            return exact
+        for fname in sorted(os.listdir(root)):
+            if fname.startswith(name + "-") and fname.endswith(".params"):
+                return os.path.join(root, fname)
+    raise RuntimeError(
+        "Pretrained model file for %r not found under %s. This "
+        "environment has no network egress; place the reference-format "
+        ".params file there manually." % (name, root))
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    """Removes cached pretrained models (reference: model_store.py:106)."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

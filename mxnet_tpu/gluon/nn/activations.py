@@ -1,0 +1,102 @@
+"""Gluon activation layers.
+
+Reference: python/mxnet/gluon/nn/activations.py (Activation, LeakyReLU,
+PReLU, ELU, SELU, Swish). All lower onto single XLA elementwise ops that
+fuse into neighbors.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ... import initializer
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish",
+           "GELU"]
+
+
+class Activation(HybridBlock):
+    """Applies an activation function (reference: nn/activations.py:30)."""
+
+    def __init__(self, activation, **kwargs):
+        self._act_type = activation
+        super().__init__(**kwargs)
+
+    def _alias(self):
+        return self._act_type
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type, name="fwd")
+
+    def __repr__(self):
+        return "{name}({_act_type})".format(
+            name=self.__class__.__name__, **self.__dict__)
+
+
+class LeakyReLU(HybridBlock):
+    """Leaky ReLU: f(x) = max(x, alpha*x)
+    (reference: nn/activations.py:59)."""
+
+    def __init__(self, alpha, **kwargs):
+        assert alpha >= 0, "Slope coefficient for LeakyReLU must be >= 0."
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha,
+                           name="fwd")
+
+    def __repr__(self):
+        return "{name}({alpha})".format(
+            name=self.__class__.__name__, alpha=self._alpha)
+
+
+class PReLU(HybridBlock):
+    """Parametric leaky ReLU with learned slope
+    (reference: nn/activations.py:91)."""
+
+    def __init__(self, alpha_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        if alpha_initializer is None:
+            alpha_initializer = initializer.Constant(0.25)
+        with self.name_scope():
+            self.alpha = self.params.get("alpha", shape=(1,),
+                                         init=alpha_initializer)
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type="prelu", name="fwd")
+
+
+class ELU(HybridBlock):
+    """Exponential Linear Unit (reference: nn/activations.py:118)."""
+
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    """Scaled Exponential Linear Unit (reference: nn/activations.py:145)."""
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu", name="fwd")
+
+
+class Swish(HybridBlock):
+    """Swish: x * sigmoid(beta*x) (reference: nn/activations.py:163)."""
+
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(x * self._beta)
+
+
+class GELU(HybridBlock):
+    """Gaussian Error Linear Unit (TPU addition; maps to a single fused
+    XLA op chain)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type="gelu", name="fwd")

@@ -1,0 +1,6 @@
+"""Gluon recurrent layers and cells (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *
+
+from . import rnn_cell
+from . import rnn_layer

@@ -1,0 +1,228 @@
+"""Gluon fused RNN layers: RNN, LSTM, GRU.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer backed by the
+fused `RNN` op / cuDNN, SURVEY.md N5b).
+
+TPU-native: the fused RNN op is a lax.scan whose input projection is
+hoisted into one big MXU matmul (ops/nn.py _run_rnn_layer) — the whole
+sequence executes inside a single XLA computation, the reference's
+cuDNN-fused-kernel role. Parameters are kept per-layer/direction (API
+parity) and concatenated into the op's packed vector at trace time, so
+the concat is a compile-time layout, not a runtime copy.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ... import ndarray
+from ...ndarray import NDArray
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """Base class for RNN layers (reference: rnn_layer.py:33)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        self._mode = mode  # set before Block.__init__ calls _alias()
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4,
+                       "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=i2h_bias_initializer)
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, *args):
+        """Set parameter shapes from the input's feature size (the packed
+        param vector can't be back-inferred through concat, so compute the
+        per-layer shapes directly like the reference's ListArguments)."""
+        ni = args[0].shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "%s%d_i2h_weight" % (j, i)).shape = \
+                    (ng * nh, ni)
+                getattr(self, "%s%d_h2h_weight" % (j, i)).shape = \
+                    (ng * nh, nh)
+                getattr(self, "%s%d_i2h_bias" % (j, i)).shape = (ng * nh,)
+                getattr(self, "%s%d_h2h_bias" % (j, i)).shape = (ng * nh,)
+            ni = nh * self._dir
+
+    def begin_state(self, batch_size=0, func=ndarray.zeros, **kwargs):
+        """Initial recurrent state (reference: rnn_layer.py:158)."""
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info = dict(info)
+                info.update(kwargs)
+            else:
+                info = dict(kwargs)
+            info.pop("__layout__", None)
+            states.append(func(**info))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        if F is ndarray:
+            batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            if F is ndarray:
+                states = self.begin_state(batch_size)
+            else:
+                # symbolic zeros with batch size taken from the input: a
+                # zero (B,) reduction broadcast to (L*dir, B, H)
+                naxis = self._layout.find("N")
+                axes = [i for i in range(3) if i != naxis]
+                z = F.sum(inputs, axis=axes) * 0
+                z = F.reshape(z, shape=(1, -1, 1))
+                z = F.broadcast_axis(
+                    z, axis=(0, 2),
+                    size=(self._num_layers * self._dir, self._hidden_size))
+                states = [z for _ in self.state_info(0)]
+        if isinstance(states, (NDArray,)) or (
+                not isinstance(states, (list, tuple))):
+            states = [states]
+        if F is ndarray:
+            for state, info in zip(states, self.state_info(batch_size)):
+                if state.shape != info["shape"]:
+                    raise ValueError(
+                        "Invalid recurrent state shape. Expecting %s, "
+                        "got %s." % (str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(F, inputs, states, **kwargs)
+        # out is (output, state0, [state1])
+        return out[0] if skip_states else (out[0], list(out[1:]))
+
+    def _forward_kernel(self, F, inputs, states, **kwargs):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        pieces = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                for t in ("i2h_weight", "h2h_weight", "i2h_bias",
+                          "h2h_bias"):
+                    pieces.append(F.reshape(
+                        kwargs["%s%d_%s" % (j, i, t)], shape=(-1,)))
+        params = F.concat(*pieces, dim=0)
+
+        rnn_args = [inputs, params] + list(states)
+        rnn = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2,
+                    p=self._dropout, state_outputs=True, mode=self._mode)
+        outputs = rnn[0]
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        return tuple([outputs] + list(rnn[1:]))
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (reference: rnn_layer.py:244)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference: rnn_layer.py:355)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference: rnn_layer.py:476)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

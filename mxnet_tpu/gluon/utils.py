@@ -1,0 +1,113 @@
+"""Gluon utility functions.
+
+Reference: python/mxnet/gluon/utils.py (split_data, split_and_load,
+clip_global_norm, check_sha1, download).
+
+TPU note: split_and_load keeps reference semantics (a list of per-device
+slices). The preferred TPU path is to NOT split — hand the full batch to a
+pjit-sharded step and let the mesh sharding distribute it — but Module's
+DataParallelExecutorGroup and existing user code use these helpers.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from .. import ndarray
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Splits an NDArray into num_slice slices along batch_axis
+    (reference: utils.py:36)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Splits an NDArray into len(ctx_list) slices and loads each to one
+    context (reference: utils.py:87)."""
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescales arrays so that the sum of their 2-norms is <= max_norm
+    (reference: utils.py:117). One fused XLA computation."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        arr_np = arr._data
+        total_norm = total_norm + (arr_np.astype("float32") ** 2).sum()
+    total_norm = float(np.sqrt(float(total_norm)))
+    if check_isfinite and not np.isfinite(total_norm):
+        import warnings
+        warnings.warn(
+            UserWarning("nan or inf is detected. Clipping results will be "
+                        "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set(arr._data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check whether the sha1 hash of the file content matches
+    (reference: utils.py:160)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file from a URL (reference: utils.py:186).
+
+    This environment has no egress; the function resolves only local
+    file:// urls or already-downloaded files, raising otherwise."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[len("file://"):], fname)
+        return fname
+    raise RuntimeError(
+        "download(%r) requires network egress, which is unavailable; "
+        "place the file at %r manually." % (url, fname))

@@ -28,6 +28,13 @@ def register(klass):
     return klass
 
 
+def register_alias(klass, name):
+    """Register an initializer class under an explicit name (used by Gluon
+    Constant parameters; reference: mx.init.register alias path)."""
+    _REGISTRY[name.lower()] = klass
+    return klass
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
@@ -64,6 +71,16 @@ class Initializer:
     def __call__(self, desc, arr):
         if not isinstance(desc, str):
             raise TypeError("desc must be an initializer name string")
+        # initializers compute on the default backend but the parameter may
+        # be committed elsewhere; NDArray._set owns the keep-placement rule
+        before = arr._data
+        self._dispatch(desc, arr)
+        if arr._data is not before:
+            new = arr._data
+            arr._data = before
+            arr._set(new)
+
+    def _dispatch(self, desc, arr):
         if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
             create(json.loads(desc.attrs["__init__"])[0],
                    **json.loads(desc.attrs["__init__"])[1])._init_weight(
@@ -358,3 +375,9 @@ class FusedRNN(Initializer):
         arr._data = jax.random.uniform(
             _random.next_key(), arr.shape, jnp.float32, -scale,
             scale).astype(arr._data.dtype)
+
+
+# registry aliases matching the reference's registered names
+# (mx.init registers Zero as "zeros" and One as "ones")
+register_alias(Zero, "zeros")
+register_alias(One, "ones")

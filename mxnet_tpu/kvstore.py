@@ -86,7 +86,9 @@ class KVStore:
             if self._updater is not None:
                 self._updater(_updater_key(k), NDArray(merged), self._data[k])
             else:
-                self._data[k]._data = self._data[k]._data + merged
+                # no updater: store the merged value (reference
+                # kvstore_local PushImpl copies the reduce result)
+                self._data[k]._data = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)

@@ -390,6 +390,16 @@ class NDArray:
 
     # in-place fill used by initializers / optimizer states
     def _set(self, jax_value):
+        """Overwrite the backing buffer, keeping the existing device
+        placement (so initializers can't silently migrate a committed
+        array across backends)."""
+        old = self._data
+        if isinstance(old, jax.Array) and isinstance(jax_value, jax.Array):
+            try:
+                if old.sharding != jax_value.sharding:
+                    jax_value = jax.device_put(jax_value, old.sharding)
+            except (AttributeError, ValueError):
+                pass
         self._data = jax_value
         return self
 

@@ -1,0 +1,479 @@
+"""Contrib operators.
+
+Reference: src/operator/contrib/ (SURVEY.md N5d) — CTC loss
+(ctc_loss.cc), bounding_box.cc (box_nms/box_iou), MultiBoxPrior/Target/
+Detection (multibox_*.cc), ROIAlign (roi_align.cc), bilinear_resize
+(bilinear_resize.cc), adaptive_avg_pool (adaptive_avg_pooling.cc),
+quadratic (quadratic_op.cc tutorial op).
+
+TPU-native designs: everything here is static-shape. NMS is the classic
+dynamic-shape op; it is implemented as a fixed-iteration masked suppression
+loop (lax.fori_loop over a score-sorted box list) which XLA compiles to a
+fixed program — same output convention as the reference (suppressed boxes
+get id -1). CTC is a log-space alpha recursion as one lax.scan over time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/contrib/ctc_loss.cc; exposed as
+# mx.nd.contrib.CTCLoss / ctc_loss)
+# ---------------------------------------------------------------------------
+@register("_contrib_CTCLoss", aliases=("_contrib_ctc_loss",))
+def _ctc_loss(data, label, *rest, use_data_lengths=False,
+              use_label_lengths=False, blank_label="first"):
+    """CTC alignment loss.
+
+    data: (T, N, C) unnormalized activations (softmax applied internally,
+    like the reference). label: (N, L) padded class indices. With
+    blank_label='first', index 0 is blank and padding value 0 terminates
+    the label; with 'last', blank = C-1 and padding is -1. Extra inputs
+    (data_lengths, label_lengths) are present iff the use_* flags are set,
+    exactly like the reference op's ListArguments.
+    """
+    data_lengths = label_lengths = None
+    idx = 0
+    if use_data_lengths:
+        data_lengths = rest[idx]
+        idx += 1
+    if use_label_lengths:
+        label_lengths = rest[idx]
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    label = label.astype(jnp.int32)
+
+    if blank_label == "first":
+        blank = 0
+        valid = label > 0
+    else:
+        blank = C - 1
+        valid = label >= 0
+
+    if label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum(valid.astype(jnp.int32), axis=1)
+    if data_lengths is not None:
+        seq_len = data_lengths.astype(jnp.int32)
+    else:
+        seq_len = jnp.full((N,), T, dtype=jnp.int32)
+
+    # extended label: blank, l1, blank, l2, ..., blank — length S = 2L+1
+    S = 2 * L + 1
+    lab_safe = jnp.where(valid, label, blank)
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab_safe)
+    s_idx = jnp.arange(S)[None, :]
+    s_valid = s_idx < (2 * lab_len + 1)[:, None]
+
+    # skip-transition allowed where ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((N, 2), -1, dtype=jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((N, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = jnp.where(lab_len > 0, ext[:, 1], blank)
+    alpha0 = alpha0.at[:, 1].set(jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(logp[0], first_lab[:, None], axis=1)[:, 0],
+        _NEG_INF))
+    alpha0 = jnp.where(s_valid, alpha0, _NEG_INF)
+
+    def step(alpha, t):
+        lp = jnp.take_along_axis(logp[t], ext, axis=1)  # (N, S)
+        a_prev = alpha
+        a_m1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        a_m2 = jnp.where(can_skip, a_m2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2) + lp
+        merged = jnp.where(s_valid, merged, _NEG_INF)
+        # freeze alpha past each sequence's length
+        active = (t < seq_len)[:, None]
+        new_alpha = jnp.where(active, merged, alpha)
+        return new_alpha, None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # loss = -log(alpha[last] + alpha[last-1]) at s = 2*lab_len, 2*lab_len-1
+    end0 = 2 * lab_len
+    end1 = jnp.maximum(end0 - 1, 0)
+    aT0 = jnp.take_along_axis(alphaT, end0[:, None], axis=1)[:, 0]
+    aT1 = jnp.take_along_axis(alphaT, end1[:, None], axis=1)[:, 0]
+    aT1 = jnp.where(lab_len > 0, aT1, _NEG_INF)
+    return -jnp.logaddexp(aT0, aT1)
+
+
+# ---------------------------------------------------------------------------
+# box utilities (reference: src/operator/contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+def _box_area(box):
+    return jnp.maximum(box[..., 2] - box[..., 0], 0) * \
+        jnp.maximum(box[..., 3] - box[..., 1], 0)
+
+
+def _pair_iou(a, b):
+    """IOU between (..., M, 4) and (..., K, 4) corner boxes ->(..., M, K)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = _box_area(a)[..., :, None]
+    area_b = _box_area(b)[..., None, :]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou")
+def _box_iou(lhs, rhs, *, format="corner"):
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _pair_iou(lhs, rhs)
+
+
+def _center_to_corner(box):
+    cx, cy, w, h = (box[..., 0], box[..., 1], box[..., 2], box[..., 3])
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_box_nms", aliases=("_contrib_box_non_maximum_suppression",))
+def _box_nms(data, *, overlap_thresh=0.5, valid_thresh=0,
+             topk=-1, coord_start=2, score_index=1, id_index=-1,
+             background_id=-1, force_suppress=False, in_format="corner",
+             out_format="corner"):
+    """Non-maximum suppression with static shapes.
+
+    The reference sorts by score and greedily suppresses
+    (bounding_box.cc). Here: sort (static), then a fixed O(n^2) masked
+    suppression sweep — XLA unrolls it into dense vector ops, which beats
+    dynamic early-exit loops on TPU. Suppressed entries get score/id -1,
+    matching the reference's output convention.
+    """
+    shape = data.shape
+    boxes = data.reshape((-1,) + shape[-2:])  # (B, N, E)
+    B, N, E = boxes.shape
+
+    scores = boxes[..., score_index]
+    order = jnp.argsort(-scores, axis=1)
+    sorted_boxes = jnp.take_along_axis(boxes, order[..., None], axis=1)
+    sc = sorted_boxes[..., score_index]
+    valid = sc > valid_thresh
+    if topk > 0:
+        valid = valid & (jnp.arange(N)[None, :] < topk)
+
+    coords = lax.dynamic_slice_in_dim(sorted_boxes, coord_start, 4, axis=2)
+    if in_format == "center":
+        coords = _center_to_corner(coords)
+    iou = _pair_iou(coords, coords)  # (B, N, N)
+    if id_index >= 0 and not force_suppress:
+        ids = sorted_boxes[..., id_index]
+        same_class = ids[..., :, None] == ids[..., None, :]
+        iou = jnp.where(same_class, iou, 0.0)
+
+    upper = jnp.triu(jnp.ones((N, N), dtype=bool), k=1)[None]
+
+    def body(i, keep):
+        # suppress everything overlapped by box i (if i itself kept)
+        sup = (iou[:, i, :] > overlap_thresh) & upper[:, i, :] & \
+            keep[:, i][:, None]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, N, body, valid)
+    keep = keep & valid
+    out = jnp.where(keep[..., None], sorted_boxes,
+                    jnp.full((1, 1, E), -1.0, dtype=sorted_boxes.dtype))
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox ops for SSD (reference: src/operator/contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", aliases=("_contrib_multibox_prior",))
+def _multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD prior (anchor) boxes: (1, H*W*(S+R-1), 4).
+
+    Computed with static shapes from the feature-map size; pure jnp
+    meshgrid math (the reference loops per pixel on CPU/GPU).
+    """
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in np.atleast_1d(np.asarray(sizes)))
+    ratios = tuple(float(r) for r in np.atleast_1d(np.asarray(ratios)))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H,W,2)
+
+    wh = []
+    for s in sizes:
+        wh.append((s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        wh.append((sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r)))
+    wh = jnp.asarray(wh)  # (A, 2) — (w, h)
+    A = wh.shape[0]
+
+    cxs = jnp.broadcast_to(cyx[:, :, None, 1], (H, W, A))
+    cys = jnp.broadcast_to(cyx[:, :, None, 0], (H, W, A))
+    ws = jnp.broadcast_to(wh[None, None, :, 0], (H, W, A))
+    hs = jnp.broadcast_to(wh[None, None, :, 1], (H, W, A))
+    boxes = jnp.stack([cxs - ws / 2, cys - hs / 2, cxs + ws / 2,
+                       cys + hs / 2], axis=-1)
+    boxes = boxes.reshape(1, H * W * A, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("_contrib_MultiBoxTarget", aliases=("_contrib_multibox_target",),
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign ground-truth to anchors for SSD training.
+
+    Outputs (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)).
+    Matching: per-GT argmax anchor + anchors with IOU > threshold
+    (the reference's bipartite + per-prediction matching).
+    """
+    anchors = anchor.reshape(-1, 4)  # (N, 4) corner
+    N = anchors.shape[0]
+    B, M, _ = label.shape  # label: (B, M, 5) [cls, xmin, ymin, xmax, ymax]
+    gt_valid = label[..., 0] >= 0  # (B, M)
+    gt_boxes = label[..., 1:5]
+    iou = _pair_iou(anchors[None], gt_boxes)  # (B, N, M)
+    iou = jnp.where(gt_valid[:, None, :], iou, 0.0)
+
+    best_gt = jnp.argmax(iou, axis=2)           # (B, N)
+    best_iou = jnp.max(iou, axis=2)             # (B, N)
+    matched = best_iou > overlap_threshold
+    # force-match: for each valid gt, its argmax anchor
+    best_anchor = jnp.argmax(iou, axis=1)       # (B, M)
+    force = jnp.zeros((B, N), dtype=bool)
+    bidx = jnp.arange(B)[:, None]
+    force = force.at[bidx, best_anchor].set(gt_valid)
+    gt_of_force = jnp.zeros((B, N), dtype=jnp.int32)
+    gt_of_force = gt_of_force.at[bidx, best_anchor].set(
+        jnp.broadcast_to(jnp.arange(M)[None], (B, M)))
+    assigned_gt = jnp.where(force, gt_of_force, best_gt)
+    pos = matched | force
+
+    picked = jnp.take_along_axis(gt_boxes, assigned_gt[..., None], axis=1)
+    # encode regression target with variances (center-size space)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = picked[..., 2] - picked[..., 0]
+    gh = picked[..., 3] - picked[..., 1]
+    gcx = (picked[..., 0] + picked[..., 2]) / 2
+    gcy = (picked[..., 1] + picked[..., 3]) / 2
+    tx = (gcx - acx[None]) / jnp.maximum(aw[None], 1e-12) / variances[0]
+    ty = (gcy - acy[None]) / jnp.maximum(ah[None], 1e-12) / variances[1]
+    tw = jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw[None], 1e-12)) \
+        / variances[2]
+    th = jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah[None], 1e-12)) \
+        / variances[3]
+    box_target = jnp.stack([tx, ty, tw, th], axis=-1)  # (B, N, 4)
+    box_target = jnp.where(pos[..., None], box_target, 0.0)
+    box_mask = jnp.where(pos[..., None],
+                         jnp.ones_like(box_target), 0.0)
+
+    cls_of_anchor = jnp.take_along_axis(
+        label[..., 0], assigned_gt, axis=1)  # (B, N)
+    cls_target = jnp.where(pos, cls_of_anchor + 1, 0.0)  # 0 = background
+
+    if negative_mining_ratio > 0:
+        # hard negative mining by background confidence (cls_pred is
+        # (B, num_classes+1, N) like the reference)
+        bg_logp = jax.nn.log_softmax(
+            cls_pred.astype(jnp.float32), axis=1)[:, 0, :]  # (B, N)
+        neg_score = -bg_logp  # high = hard negative
+        neg_score = jnp.where(pos, _NEG_INF, neg_score)
+        n_pos = jnp.sum(pos, axis=1, keepdims=True)
+        quota = jnp.maximum(
+            (n_pos * negative_mining_ratio).astype(jnp.int32),
+            minimum_negative_samples)
+        rank = jnp.argsort(jnp.argsort(-neg_score, axis=1), axis=1)
+        keep_neg = rank < quota
+        cls_target = jnp.where(~pos & ~keep_neg,
+                               jnp.float32(ignore_label), cls_target)
+    return (box_target.reshape(B, N * 4), box_mask.reshape(B, N * 4),
+            cls_target)
+
+
+@register("_contrib_MultiBoxDetection",
+          aliases=("_contrib_multibox_detection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions into (B, N, 6) [id, score, x1, y1, x2, y2]."""
+    B = cls_prob.shape[0]
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    loc = loc_pred.reshape(B, N, 4)
+    cx = loc[..., 0] * variances[0] * aw[None] + acx[None]
+    cy = loc[..., 1] * variances[1] * ah[None] + acy[None]
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw[None]
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah[None]
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # best non-background class per anchor
+    probs = jnp.moveaxis(cls_prob, 1, 2)  # (B, N, C)
+    fg = probs.at[:, :, background_id].set(-1.0)
+    cls_id = jnp.argmax(fg, axis=2)
+    score = jnp.max(fg, axis=2)
+    keep = score > threshold
+    det = jnp.concatenate(
+        [jnp.where(keep, cls_id - (cls_id > background_id), -1.0)[..., None]
+         .astype(boxes.dtype),
+         jnp.where(keep, score, -1.0)[..., None], boxes], axis=-1)
+    return _box_nms(det, overlap_thresh=nms_threshold,
+                    valid_thresh=threshold,
+                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                    force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# pooling / resize contrib (reference: adaptive_avg_pooling.cc,
+# bilinear_resize.cc, roi_align.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool2d(data, *, output_size=(1, 1)):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if len(output_size) == 1:
+        output_size = (output_size[0], output_size[0])
+    B, C, H, W = data.shape
+    oh, ow = output_size
+    x = data.reshape(B, C, oh, H // oh, ow, W // ow) \
+        if H % oh == 0 and W % ow == 0 else None
+    if x is not None:
+        return jnp.mean(x, axis=(3, 5))
+    # general path: interpolation-style average via resize weights
+    return jax.image.resize(data, (B, C, oh, ow), method="linear")
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None):
+    B, C, H, W = data.shape
+    if height <= 0:
+        height = int(H * (scale_height or 1.0))
+    if width <= 0:
+        width = int(W * (scale_width or 1.0))
+    return jax.image.resize(data, (B, C, height, width), method="linear")
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False):
+    """ROI Align (reference: roi_align.cc). rois: (R, 5) [batch, x1, y1,
+    x2, y2]. Bilinear sampling at fixed grid points — a gather+matmul
+    pattern XLA vectorizes."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    R = rois.shape[0]
+    C, H, W = data.shape[1], data.shape[2], data.shape[3]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = rois[:, 1] * spatial_scale
+    y1 = rois[:, 2] * spatial_scale
+    x2 = rois[:, 3] * spatial_scale
+    y2 = rois[:, 4] * spatial_scale
+    rw = jnp.maximum(x2 - x1, 1e-6)
+    rh = jnp.maximum(y2 - y1, 1e-6)
+    ns = 2 if sample_ratio <= 0 else sample_ratio
+    # sample grid: (R, ph*ns, pw*ns)
+    ys = y1[:, None] + rh[:, None] * \
+        ((jnp.arange(ph * ns) + 0.5) / (ph * ns))[None]
+    xs = x1[:, None] + rw[:, None] * \
+        ((jnp.arange(pw * ns) + 0.5) / (pw * ns))[None]
+
+    def bilinear(img, yy, xx):
+        # img (C, H, W); yy (hs,), xx (ws,) -> (C, hs, ws)
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1c = jnp.clip(y0 + 1, 0, H - 1)
+        x1c = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy, 0, H - 1) - y0
+        wx = jnp.clip(xx, 0, W - 1) - x0
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1c]
+        v10 = img[:, y1c][:, :, x0]
+        v11 = img[:, y1c][:, :, x1c]
+        wy = wy[None, :, None]
+        wx = wx[None, None, :]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def per_roi(b, yy, xx):
+        img = data[b]
+        samp = bilinear(img, yy, xx)  # (C, ph*ns, pw*ns)
+        return jnp.mean(samp.reshape(C, ph, ns, pw, ns), axis=(2, 4))
+
+    return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+
+@register("_contrib_quadratic")
+def _quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """Tutorial op f(x) = a*x^2 + b*x + c
+    (reference: quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    """Transformer helper: x / sqrt(d) (reference: transformer.cc)."""
+    return data / jnp.sqrt(jnp.float32(data.shape[-1]))
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Count sketch projection (reference: count_sketch.cc). Scatter-add
+    into out_dim buckets."""
+    B, D = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)[:D]
+    ss = s.reshape(-1)[:D]
+    vals = data * ss[None, :]
+    out = jnp.zeros((B, int(out_dim)), dtype=data.dtype)
+    return out.at[:, hh].add(vals)
+
+
+@register("_contrib_fft")
+def _fft(data, *, compute_size=128):
+    """FFT (reference: fft.cc). Returns interleaved re/im like the
+    reference: (..., 2*D)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+@register("_contrib_ifft")
+def _ifft(data, *, compute_size=128):
+    D = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (D, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32)

@@ -297,8 +297,10 @@ def _deconvolution(data, weight, *rest, kernel, num_filter, stride=None,
     pad = tuple_param(pad, nd) or (0,) * nd
     adj = tuple_param(adj, nd) or (0,) * nd
     lhs_spec, _, out_spec = _conv_dim_numbers(nd, layout)
-    # grad-of-conv formulation: conv_transpose with IO spec
-    rhs_spec = "IO" + lhs_spec[2:]
+    # grad-of-conv formulation: with transpose_kernel=True the kernel is
+    # given in the matching FORWARD conv's layout; the reference's weight
+    # (in_channels, num_filter//g, *k) is exactly that fwd kernel OI+sp
+    rhs_spec = "OI" + lhs_spec[2:]
     dn = lax.conv_dimension_numbers(x.shape, weight.shape,
                                     (lhs_spec, rhs_spec, out_spec))
     # padding for transposed conv: k - 1 - p (+ output adj handled by XLA)

@@ -88,6 +88,11 @@ def _swapaxes(x, *, dim1=0, dim2=0):
     return jnp.swapaxes(x, dim1, dim2)
 
 
+@register("reshape_like")
+def _reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
 @register("slice")
 def _slice(x, *, begin, end, step=None):
     step = step or (None,) * len(begin)

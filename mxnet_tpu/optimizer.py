@@ -101,6 +101,12 @@ class Optimizer:
             self.update(index, weight, grad, state)
 
     # -- lr/wd plumbing (reference: optimizer.py:160-260) ----------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise MXNetError("lr_scheduler is set; cannot set lr directly")

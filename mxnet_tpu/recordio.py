@@ -1,0 +1,244 @@
+"""RecordIO: the reference's record-packed dataset container format.
+
+Reference: python/mxnet/recordio.py (MXRecordIO, MXIndexedRecordIO,
+IRHeader, pack/unpack/pack_img/unpack_img) over dmlc-core's recordio
+binary format (3rdparty/dmlc-core). File-format compatible: records are
+magic-framed, 4-byte aligned, with the image-record IRHeader prefix, so
+.rec files round-trip with the reference.
+"""
+from __future__ import annotations
+
+import ctypes
+import io as _pyio
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_LENGTH_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference: recordio.py:37)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("handle", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        self.handle = None
+        if is_open:
+            self.open()
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+
+    def reset(self):
+        """Resets read head to the beginning."""
+        self.close()
+        self.open()
+
+    def tell(self):
+        """Current position of the file head."""
+        return self.handle.tell()
+
+    def write(self, buf):
+        """Appends one record (reference: recordio.py:154)."""
+        assert self.writable
+        data = bytes(buf)
+        upper = 0  # cflag 0: complete record (no multi-part split)
+        lrec = (upper << 29) | (len(data) & _LENGTH_MASK)
+        self.handle.write(struct.pack("<II", _kMagic, lrec))
+        self.handle.write(data)
+        pad = (4 - (len(data) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        """Reads the next record; None at EOF
+        (reference: recordio.py:180)."""
+        assert not self.writable
+        hdr = self.handle.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _kMagic:
+            raise IOError("Invalid RecordIO magic in %s" % self.uri)
+        length = lrec & _LENGTH_MASK
+        data = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with a .idx sidecar
+    (reference: recordio.py:211)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if not self.writable:
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                if len(line) < 2:
+                    continue
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("fidx", None)
+        return d
+
+    def seek(self, idx):
+        """Sets read head to the record with the given key."""
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        """Reads the record with the given key."""
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """Writes a record keyed by idx."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# image-record header (reference: recordio.py:302)
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Packs a string byte sequence into an image record
+    (reference: recordio.py:309)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(label=float(header.label))
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Unpacks a record into header and payload
+    (reference: recordio.py:349)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpacks a record into header and decoded image
+    (reference: recordio.py:377)."""
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Packs an image into a record (reference: recordio.py:410).
+
+    Uses PIL (OpenCV's role in the reference) when available; raw numpy
+    fallback encodes lossless .npy."""
+    try:
+        from PIL import Image
+        buf = _pyio.BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(np.asarray(img).astype(np.uint8)).save(
+            buf, format=fmt, quality=quality)
+        return pack(header, buf.getvalue())
+    except ImportError:
+        buf = _pyio.BytesIO()
+        np.save(buf, np.asarray(img))
+        return pack(header, b"NPY0" + buf.getvalue())
+
+
+def _imdecode(s, iscolor=-1):
+    if s[:4] == b"NPY0":
+        return np.load(_pyio.BytesIO(s[4:]))
+    try:
+        from PIL import Image
+        img = Image.open(_pyio.BytesIO(s))
+        if iscolor == 0:
+            img = img.convert("L")
+        elif iscolor == 1:
+            img = img.convert("RGB")
+        return np.asarray(img)
+    except ImportError as e:
+        raise RuntimeError(
+            "Decoding compressed images requires PIL, which is "
+            "unavailable; use .npy-packed records (pack_img fallback)."
+        ) from e

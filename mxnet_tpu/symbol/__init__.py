@@ -38,3 +38,6 @@ def _refresh_namespaces():
     for _name in list(_g):
         if _name.startswith("_contrib_"):
             contrib.__dict__[_name[len("_contrib_"):]] = _g[_name]
+
+
+_refresh_namespaces()

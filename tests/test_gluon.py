@@ -1,0 +1,277 @@
+"""Gluon frontend tests.
+
+Mirrors the reference's tests/python/unittest/test_gluon.py: parameter
+management, block composition, hybridize consistency, layer shapes,
+save/load round-trips, trainer convergence.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.parameter import Parameter, ParameterDict, \
+    DeferredInitializationError
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+    with pytest.raises(RuntimeError):
+        p.grad()
+
+
+def test_parameter_dict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_pd.params")
+    params.load("/tmp/test_pd.params", mx.cpu())
+    # shared dict finds the same parameter
+    shared = gluon.ParameterDict("net_", shared=params)
+    w2 = shared.get("weight")
+    assert w2 is params["net_weight"]
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]], dtype="float32")
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+
+
+def test_basic_blocks():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256))
+    model.add(nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+    model.initialize()
+    x = mx.nd.zeros((32, 2, 10))
+    out = model(x)
+    assert out.shape == (32, 32)
+    params = model.collect_params()
+    assert len(params) == 6
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.sym.Variable("data")
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == \
+        {"test_weight", "test_bias"}
+    model.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 10))
+    assert model(x).shape == (2, 3, 128)
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    model.initialize()
+    x = mx.nd.random.uniform(shape=(17, 2, 5, 3))
+    assert model(x).shape == (17, 128)
+
+
+def _check_hybrid_consistency(net, x, atol=1e-5):
+    net.initialize()
+    eager = net(x).asnumpy()
+    net.hybridize()
+    jitted = net(x).asnumpy()
+    np.testing.assert_allclose(eager, jitted, rtol=1e-5, atol=atol)
+
+
+def test_hybrid_consistency_mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.BatchNorm())
+        net.add(nn.Dense(10))
+    _check_hybrid_consistency(net, mx.nd.random.uniform(shape=(4, 16)))
+
+def test_hybrid_consistency_conv():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    _check_hybrid_consistency(
+        net, mx.nd.random.uniform(shape=(2, 3, 8, 8)))
+
+
+def test_conv_layers():
+    for layer, shape, out_shape in [
+            (nn.Conv1D(16, 3, in_channels=4), (2, 4, 10), (2, 16, 8)),
+            (nn.Conv2D(16, 3, strides=2, in_channels=4), (2, 4, 10, 10),
+             (2, 16, 4, 4)),
+            (nn.Conv3D(16, 3, in_channels=4), (2, 4, 8, 8, 8),
+             (2, 16, 6, 6, 6)),
+            (nn.Conv2DTranspose(16, 3, in_channels=4), (2, 4, 5, 5),
+             (2, 16, 7, 7)),
+            (nn.MaxPool2D(2), (2, 4, 8, 8), (2, 4, 4, 4)),
+            (nn.AvgPool2D(2), (2, 4, 8, 8), (2, 4, 4, 4)),
+            (nn.GlobalAvgPool2D(), (2, 4, 8, 8), (2, 4, 1, 1)),
+            (nn.GlobalMaxPool2D(), (2, 4, 8, 8), (2, 4, 1, 1))]:
+        layer.initialize()
+        out = layer(mx.nd.random.uniform(shape=shape))
+        assert out.shape == out_shape, (layer, out.shape)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.random.uniform(shape=(8, 4, 3, 3))
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, np.zeros(4)), "moving mean not updated"
+
+
+def test_deferred_init():
+    net = nn.Dense(10)
+    net.initialize()
+    # shape unknown until first forward
+    with pytest.raises(DeferredInitializationError):
+        net.weight.data()
+    net(mx.nd.ones((2, 7)))
+    assert net.weight.shape == (10, 7)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    y = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(16, in_units=8))
+        net2.add(nn.Dense(4, in_units=16))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), y, rtol=1e-6)
+
+
+def test_export_import(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8, activation="relu"))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 8))
+    y = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0000.params")
+    np.testing.assert_allclose(net2(x).asnumpy(), y, rtol=1e-5)
+
+
+def test_trainer_convergence():
+    np.random.seed(0)
+    X = np.random.randn(64, 10).astype("float32")
+    w = np.random.randn(10, 1).astype("float32")
+    Y = X @ w
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    L = gluon.loss.L2Loss()
+    xb, yb = mx.nd.array(X), mx.nd.array(Y)
+    for _ in range(100):
+        with autograd.record():
+            l = L(net(xb), yb)
+        l.backward()
+        trainer.step(64)
+    assert float(l.mean().asscalar()) < 1e-2
+
+
+def test_trainer_lr():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_block_apply_and_cast():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    seen = []
+    net.apply(lambda b: seen.append(b.name))
+    assert len(seen) >= 2
+    net.cast("float16")
+    assert net[0].weight.data().dtype == np.float16
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 5)
+    layer.initialize()
+    x = mx.nd.array([0, 2, 5])
+    out = layer(x)
+    assert out.shape == (3, 5)
+    with autograd.record():
+        y = layer(x).sum()
+    y.backward()
+    g = layer.weight.grad().asnumpy()
+    assert g[0].sum() != 0 and g[1].sum() == 0
+
+
+def test_lambda_blocks():
+    net = nn.Sequential()
+    net.add(nn.Lambda("tanh"))
+    net.add(nn.HybridLambda(lambda F, x: F.relu(x)))
+    x = mx.nd.array([[-1.0, 2.0]])
+    out = net(x)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.maximum(np.tanh([[-1.0, 2.0]]), 0),
+                               rtol=1e-5)
+
+
+def test_zero_grad():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    with autograd.record():
+        net(mx.nd.ones((2, 4))).backward()
+    assert net.weight.grad().asnumpy().sum() != 0
+    net.collect_params().zero_grad()
+    assert net.weight.grad().asnumpy().sum() == 0
