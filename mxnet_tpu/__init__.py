@@ -49,6 +49,7 @@ from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import recordio
 from . import gluon
+from . import parallel
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

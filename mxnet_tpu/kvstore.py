@@ -72,13 +72,18 @@ class KVStore:
             self._data[k] = NDArray(v[0]._data if isinstance(v, (list, tuple))
                                     else v._data)
 
+    def _after_merge(self, merged):
+        """Hook between the local reduce and the store/update step;
+        DistKVStore adds the cross-process allreduce here."""
+        return merged
+
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
             vals = v if isinstance(v, (list, tuple)) else [v]
-            merged = _sum_arrays(list(vals))
+            merged = self._after_merge(_sum_arrays(list(vals)))
             tgt = self._data[k]._data
             if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
                                                             None):

@@ -44,8 +44,14 @@ _UNARY = {
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
 }
 
+def _make_unary(fn):
+    def op(x):
+        return fn(x)
+    return op
+
+
 for _name, _fn in _UNARY.items():
-    register(_name)(lambda x, _fn=_fn: _fn(x))
+    register(_name)(_make_unary(_fn))
 
 alias("negative", "_np_negative")
 alias("reciprocal", "_rdiv_int")  # internal
@@ -118,8 +124,14 @@ _BINARY = {
     "logical_xor": _logical(jnp.logical_xor),
 }
 
+def _make_binary(fn):
+    def op(a, b):
+        return fn(a, b)
+    return op
+
+
 for _name, _fn in _BINARY.items():
-    register("broadcast_%s" % _name)(lambda a, b, _fn=_fn: _fn(a, b))
+    register("broadcast_%s" % _name)(_make_binary(_fn))
 
 # elemwise_* are the strict same-shape forms; on XLA the same kernel.
 alias("broadcast_add", "elemwise_add", "_plus", "_add")
@@ -142,10 +154,16 @@ alias("broadcast_lesser_equal", "_lesser_equal")
 # scalar forms (reference: elemwise_binary_scalar_op_basic.cc). The scalar is
 # a static param, letting XLA constant-fold it.
 
+def _make_scalar(fn):
+    def op(x, *, scalar):
+        return fn(x, scalar)
+    return op
+
+
 def _reg_scalar(name, fn, rfn=None):
-    register("_%s_scalar" % name)(lambda x, *, scalar, _fn=fn: _fn(x, scalar))
+    register("_%s_scalar" % name)(_make_scalar(fn))
     if rfn is not None:
-        register("_r%s_scalar" % name)(lambda x, *, scalar, _fn=rfn: _fn(x, scalar))
+        register("_r%s_scalar" % name)(_make_scalar(rfn))
 
 
 _reg_scalar("plus", jnp.add)
@@ -189,12 +207,12 @@ def _norm_axis(axis):
 
 
 def _reg_reduce(name, fn, exclude_ok=True):
-    def op(x, *, axis=None, keepdims=False, exclude=False, _fn=fn):
+    def op(x, *, axis=None, keepdims=False, exclude=False):
         ax = _norm_axis(axis)
         if exclude and ax is not None:
             ax = tuple(i for i in range(x.ndim) if i not in
                        tuple(a % x.ndim for a in ax))
-        return _fn(x, axis=ax, keepdims=keepdims)
+        return fn(x, axis=ax, keepdims=keepdims)
     register(name)(op)
 
 

@@ -1,0 +1,27 @@
+"""Parallelism and distributed communication (SURVEY.md §2.3, §5.8).
+
+The reference's comm stack — CommCPU/CommDevice reduce (src/kvstore/
+comm.h), tree allreduce (comm_tree.h + gpu_topology.h), NCCL rings
+(kvstore_nccl.h), ps-lite servers (kvstore_dist*.h) — collapses into XLA
+collectives over a named `jax.sharding.Mesh`:
+
+- data parallel:    `ShardedTrainer` (one pjit program; grads allreduced
+                    by XLA over ICI) or the KVStore facade for API parity
+- tensor parallel:  `param_rules` PartitionSpecs on the 'tp' axis
+- pipeline:         `pipeline_apply` (ppermute stage ring)
+- sequence/context: `ring_attention` (ppermute K/V ring, online softmax)
+- multi-host:       `DistKVStore` ('tpu_dist') over jax.distributed
+"""
+from .mesh import (make_mesh, data_parallel_mesh, replicated, shard_on,
+                   put_sharded, use_mesh, current_mesh, Mesh,
+                   NamedSharding, PartitionSpec)
+from .data_parallel import ShardedTrainer
+from .ring_attention import ring_attention, local_attention, RingAttention
+from .pipeline import pipeline_apply
+from .kvstore_dist import DistKVStore, init_distributed
+
+__all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
+           "put_sharded", "use_mesh", "current_mesh", "Mesh",
+           "NamedSharding", "PartitionSpec", "ShardedTrainer",
+           "ring_attention", "local_attention", "RingAttention",
+           "pipeline_apply", "DistKVStore", "init_distributed"]

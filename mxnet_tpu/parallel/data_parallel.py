@@ -1,0 +1,249 @@
+"""Sharded training step: the TPU-native data/tensor-parallel path.
+
+Reference mapping: this module replaces the whole reference stack of
+DataParallelExecutorGroup (module/executor_group.py:143 — slice batch,
+replicate executors), KVStore comm (src/kvstore/comm.h reduce+broadcast)
+and the optimizer drive loop (model.py:145 _update_params_on_kvstore):
+one pjit-compiled XLA program computes forward, loss, backward, gradient
+allreduce (inserted by XLA from the shardings, riding ICI) and the
+optimizer update — no per-parameter push/pull round trips.
+
+Usage::
+
+    mesh = make_mesh({"dp": 8})
+    st = ShardedTrainer(net, loss_fn, "sgd", {"learning_rate": .1},
+                        mesh=mesh)
+    for xb, yb in loader:
+        loss = st.step(xb, yb)
+    st.copy_params_to_net()
+
+Tensor parallelism: pass `param_rules` = [(regex, PartitionSpec)] to
+shard weights over the 'tp' axis; everything else is replicated. XLA
+inserts the matching all-gathers/reduce-scatters.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import symbol as _sym
+from ..graph import build_graph_fn, collect_vars
+from .. import random as _random
+from .mesh import make_mesh, replicated
+
+__all__ = ["ShardedTrainer", "sgd_init", "sgd_update", "adam_init",
+           "adam_update"]
+
+
+# --------------------------------------------------------------------------
+# fused in-graph optimizers (pytree-level; the reference's fused update ops
+# src/operator/optimizer_op.cc play this role)
+# --------------------------------------------------------------------------
+def sgd_init(params):
+    return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+
+def sgd_update(params, grads, state, lr=0.01, momentum=0.0, wd=0.0):
+    new_p, new_s = {}, {}
+    for k, p in params.items():
+        g = grads[k] + wd * p
+        m = momentum * state[k] + g
+        new_s[k] = m
+        new_p[k] = p - lr * m
+    return new_p, new_s
+
+
+def adam_init(params):
+    return {"m": {k: jnp.zeros_like(v) for k, v in params.items()},
+            "v": {k: jnp.zeros_like(v) for k, v in params.items()},
+            "t": jnp.zeros((), jnp.int32)}
+
+
+def adam_update(params, grads, state, lr=0.001, beta1=0.9, beta2=0.999,
+                eps=1e-8, wd=0.0):
+    t = state["t"] + 1
+    new_m, new_v, new_p = {}, {}, {}
+    for k, p in params.items():
+        g = grads[k] + wd * p
+        m = beta1 * state["m"][k] + (1 - beta1) * g
+        v = beta2 * state["v"][k] + (1 - beta2) * g * g
+        mhat = m / (1 - beta1 ** t)
+        vhat = v / (1 - beta2 ** t)
+        new_m[k] = m
+        new_v[k] = v
+        new_p[k] = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p, {"m": new_m, "v": new_v, "t": t}
+
+
+# defaults match mx.optimizer's SGD/Adam (optimizer.py): momentum 0
+_OPTIMIZERS = {"sgd": (sgd_init, sgd_update, {"lr": 0.01, "momentum": 0.0,
+                                              "wd": 0.0}),
+               "adam": (adam_init, adam_update,
+                        {"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                         "eps": 1e-8, "wd": 0.0})}
+
+_OPT_PARAM_ALIASES = {"learning_rate": "lr"}
+
+
+class ShardedTrainer:
+    """One-program data/tensor-parallel trainer over a device mesh."""
+
+    def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
+                 mesh=None, param_rules=None, batch_axis=0,
+                 data_names=("data",), label_names=("label",),
+                 aux_mode="train"):
+        self._net = net
+        self._mesh = mesh if mesh is not None else make_mesh()
+        self._batch_axis = batch_axis
+        self._data_names = tuple(data_names)
+        self._label_names = tuple(label_names)
+        self._param_rules = [(re.compile(p), spec)
+                             for p, spec in (param_rules or [])]
+
+        # trace net + loss into one symbol graph
+        data_syms = [_sym.var(n) for n in self._data_names]
+        label_syms = [_sym.var(n) for n in self._label_names]
+        out = net(*data_syms)
+        loss_sym = loss(out, *label_syms) if loss is not None else out
+        if isinstance(loss_sym, (list, tuple)):
+            loss_sym = loss_sym[0]
+        self._loss_sym = loss_sym
+
+        arg_nodes, aux_nodes = collect_vars(loss_sym._entries)
+        input_set = set(self._data_names) | set(self._label_names)
+        self._param_names = [n.name for n in arg_nodes
+                             if n.name not in input_set]
+        self._aux_names = [n.name for n in aux_nodes]
+        self._fn, _, _, self._needs_rng = build_graph_fn(
+            loss_sym._entries, aux_mode)
+
+        # pull initial values out of the gluon net
+        net_params = {p.name: p for p in net.collect_params().values()}
+        missing = [n for n in self._param_names + self._aux_names
+                   if n not in net_params]
+        if missing:
+            raise MXNetError(
+                "ShardedTrainer: net has no parameters %s; initialize the "
+                "net (and run one forward to materialize deferred shapes) "
+                "first" % missing)
+        self._params = {n: self._shard_param(n, net_params[n].data()._data)
+                        for n in self._param_names}
+        self._aux = {n: self._shard_param(n, net_params[n].data()._data)
+                     for n in self._aux_names}
+
+        opt_params = dict(optimizer_params or {})
+        for old, new in _OPT_PARAM_ALIASES.items():
+            if old in opt_params:
+                opt_params[new] = opt_params.pop(old)
+        if optimizer not in _OPTIMIZERS:
+            raise MXNetError("ShardedTrainer: unknown optimizer %r "
+                             "(have %s)" % (optimizer,
+                                            sorted(_OPTIMIZERS)))
+        opt_init, opt_update, defaults = _OPTIMIZERS[optimizer]
+        self._opt_hp = {**defaults, **opt_params}
+        self._opt_state = opt_init(self._params)
+        self._opt_update = opt_update
+        self._step_fn = None
+        self._step_count = 0
+
+    # -- shardings ------------------------------------------------------
+    def _spec_for(self, name):
+        for pat, spec in self._param_rules:
+            if pat.search(name):
+                return spec
+        return PartitionSpec()
+
+    def _shard_param(self, name, value):
+        return jax.device_put(
+            value, NamedSharding(self._mesh, self._spec_for(name)))
+
+    def _batch_sharding(self):
+        spec = [None] * (self._batch_axis + 1)
+        spec[self._batch_axis] = "dp" if "dp" in self._mesh.axis_names \
+            else self._mesh.axis_names[0]
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    # -- compiled step --------------------------------------------------
+    def _build_step(self):
+        fn = self._fn
+        opt_update = self._opt_update
+        hp = self._opt_hp
+
+        def step(params, aux, opt_state, inputs, key):
+            def loss_fn(p):
+                outs, auxup = fn({**p, **inputs}, aux, key)
+                return jnp.mean(outs[0]), auxup
+
+            (loss, auxup), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_state = opt_update(params, grads, opt_state,
+                                               **hp)
+            new_aux = dict(aux)
+            new_aux.update(auxup or {})
+            return new_params, new_aux, new_state, loss
+
+        param_sh = {n: NamedSharding(self._mesh, self._spec_for(n))
+                    for n in self._params}
+        aux_sh = {n: NamedSharding(self._mesh, self._spec_for(n))
+                  for n in self._aux}
+        rep = replicated(self._mesh)
+        opt_sh = _match_param_shardings(self._opt_state, param_sh, rep)
+        batch_sh = self._batch_sharding()
+        in_sh = {n: batch_sh for n in
+                 self._data_names + self._label_names}
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(param_sh, aux_sh, opt_sh, in_sh, None),
+            out_shardings=(param_sh, aux_sh, opt_sh, rep),
+            donate_argnums=(0, 1, 2))
+
+    def step(self, *batch_and_labels):
+        """Run one fused train step; returns the scalar loss NDArray."""
+        if self._step_fn is None:
+            self._build_step()
+        names = self._data_names + self._label_names
+        if len(batch_and_labels) != len(names):
+            raise MXNetError("step expects %s" % (names,))
+        sh = self._batch_sharding()
+        inputs = {}
+        for n, x in zip(names, batch_and_labels):
+            arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            inputs[n] = jax.device_put(arr, sh)
+        key = _random.next_key() if self._needs_rng else None
+        self._params, self._aux, self._opt_state, loss = self._step_fn(
+            self._params, self._aux, self._opt_state, inputs, key)
+        self._step_count += 1
+        return NDArray(loss)
+
+    # -- param sync back to the frontend --------------------------------
+    @property
+    def params(self):
+        return dict(self._params)
+
+    def copy_params_to_net(self):
+        """Write trained values back into the gluon net's Parameters."""
+        net_params = {p.name: p
+                      for p in self._net.collect_params().values()}
+        for n, v in {**self._params, **self._aux}.items():
+            gathered = jax.device_get(v)
+            net_params[n].set_data(NDArray(jnp.asarray(gathered)))
+
+
+def _match_param_shardings(opt_state, param_sh, rep):
+    """Optimizer state entries keyed like params shard like their param
+    (weight-update sharding); everything else is replicated."""
+    if isinstance(opt_state, dict):
+        out = {}
+        for k, v in opt_state.items():
+            if k in param_sh and not isinstance(v, dict):
+                out[k] = param_sh[k]
+            else:
+                out[k] = _match_param_shardings(v, param_sh, rep)
+        return out
+    return rep

@@ -1,0 +1,116 @@
+"""Device mesh utilities: the TPU-native replacement for the reference's
+device topology plumbing.
+
+Reference mapping (SURVEY.md §2.3): the reference discovers GPU P2P
+topology (src/kvstore/gpu_topology.h, 1.1k LoC of Kernighan-Lin tree
+building) and picks comm strategies per link. On TPU the ICI torus is
+XLA's problem: we declare a logical `jax.sharding.Mesh` with named axes
+and annotate shardings; XLA lowers psum/all-gather onto ICI rings.
+
+Axes convention (used across parallel/):
+  'dp' — data parallel      (batch dimension)
+  'tp' — tensor parallel    (hidden dimension of weights)
+  'pp' — pipeline parallel  (layer stages)
+  'sp' — sequence/context parallel (sequence dimension; ring attention)
+"""
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh",
+           "data_parallel_mesh", "replicated", "shard_on", "put_sharded",
+           "current_mesh", "use_mesh", "shard_map_compat"]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: new API takes check_vma, the
+    experimental one check_rep."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+_ACTIVE = []
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a Mesh from {axis_name: size}.
+
+    Sizes may include one -1 (filled with remaining devices). Defaults to
+    all devices on one 'dp' axis. Axis order follows dict order — put the
+    fastest-varying (most-communicating, e.g. 'tp') axis LAST so it maps
+    to adjacent devices/ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh %s needs %d devices but only %d available"
+                         % (dict(zip(names, sizes)), total, n))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_parallel_mesh(n=None):
+    """All (or first n) devices on one 'dp' axis."""
+    devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return make_mesh({"dp": len(devices)}, devices)
+
+
+def replicated(mesh):
+    """Sharding that replicates across the whole mesh."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_on(mesh, axis_name, dim=0, ndim=None):
+    """Sharding that splits tensor dim `dim` over mesh axis `axis_name`.
+
+    Negative `dim` requires `ndim` (the spec length can't be inferred)."""
+    if dim < 0:
+        if ndim is None:
+            raise ValueError("shard_on: negative dim requires ndim")
+        dim = dim % ndim
+    spec = [None] * (ndim if ndim is not None else dim + 1)
+    spec[dim] = axis_name
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def put_sharded(x, sharding):
+    """device_put an array (or NDArray) with the given sharding."""
+    from ..ndarray import NDArray
+    if isinstance(x, NDArray):
+        return NDArray(jax.device_put(x._data, sharding))
+    return jax.device_put(x, sharding)
+
+
+def current_mesh():
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextmanager
+def use_mesh(mesh):
+    """Scope a mesh as the active one (parallel trainers pick it up)."""
+    _ACTIVE.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE.pop()

@@ -1,0 +1,81 @@
+"""Pipeline parallelism: GPipe-style microbatched stage ring.
+
+The reference's closest capability is inter-layer model parallelism via
+ctx groups (`group2ctx` + PlaceDevice pass, SURVEY.md §2.3) where the
+engine overlaps devices opportunistically. Here pipelining is explicit
+and compiled: stages are laid out over the 'pp' mesh axis, every device
+runs the same shard_mapped program, activations hop stage→stage via
+`ppermute`, and microbatching keeps all stages busy (fill/drain bubbles
+of the classic GPipe schedule).
+
+Constraint (same as scan-based pipelining generally): all inter-stage
+activations share one shape/dtype — true for the transformer-stack use
+case this targets.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import shard_map_compat
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name="pp",
+                   n_microbatches=None):
+    """Run `n_stages` copies of stage_fn as a pipeline over the mesh axis.
+
+    stage_fn(params_i, x) -> y, with y.shape == x.shape.
+    stage_params: pytree whose leaves have leading dim n_stages (sharded
+    over `axis_name`). x: (B, ...) batch (replicated over the pp axis).
+    Returns the final-stage output, replicated like x.
+    """
+    n_stages = mesh.shape[axis_name]
+    B = x.shape[0]
+    if n_microbatches is None:
+        n_microbatches = n_stages
+    assert B % n_microbatches == 0, \
+        "batch %d must divide into %d microbatches" % (B, n_microbatches)
+    mb = B // n_microbatches
+
+    stage_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def local_fn(params, xl):
+        # params leaves are (1, ...) locally — drop the stage axis
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = lax.axis_index(axis_name)
+        micro = xl.reshape((n_microbatches, mb) + xl.shape[1:])
+        n_steps = n_microbatches + n_stages - 1
+
+        def step(t, carry):
+            buf, outputs = carry
+            # stage 0 injects microbatch t (while available)
+            inject = micro[jnp.clip(t, 0, n_microbatches - 1)]
+            x_in = jnp.where(stage == 0, inject, buf)
+            y = stage_fn(params, x_in)
+            # final stage records output for microbatch t-(n_stages-1)
+            out_idx = t - (n_stages - 1)
+            valid = (stage == n_stages - 1) & (out_idx >= 0)
+            idx = jnp.clip(out_idx, 0, n_microbatches - 1)
+            outputs = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(outputs, y, idx, 0),
+                outputs)
+            buf = lax.ppermute(y, axis_name, perm)
+            return buf, outputs
+
+        buf = jnp.zeros((mb,) + xl.shape[1:], xl.dtype)
+        outputs = jnp.zeros((n_microbatches, mb) + xl.shape[1:], xl.dtype)
+        buf, outputs = lax.fori_loop(0, n_steps, step, (buf, outputs))
+        # broadcast final-stage outputs to every stage (replicated out)
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, 0.0), axis_name)
+        return outputs.reshape(xl.shape)
+
+    fn = shard_map_compat(local_fn, mesh, (stage_spec, P()), P())
+    return fn(stage_params, x)

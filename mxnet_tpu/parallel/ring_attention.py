@@ -1,0 +1,126 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The reference (2018) has NO sequence parallelism — long sequences were
+handled by bucketing + truncated BPTT (SURVEY.md §5.7). This module is
+the modern TPU-native upgrade the task calls for: shard the sequence
+axis over a mesh axis ('sp'), keep Q local, and rotate K/V blocks around
+the ring with `ppermute` while accumulating attention in the
+numerically-stable online-softmax (flash) form. Peak memory per device is
+O(seq/devices), enabling contexts that cannot fit on one chip.
+
+Pattern sources: PAPERS.md (Ring Attention with Blockwise Transformers;
+online softmax), jax shard_map collective idioms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import shard_map_compat
+
+__all__ = ["ring_attention", "local_attention", "RingAttention"]
+
+
+def _block_attn(q, k, v, scale, carry, causal_mask=None):
+    """One (q-block, kv-block) interaction in online-softmax form.
+
+    carry = (acc (..., Tq, D), row_max (..., Tq), row_sum (..., Tq))."""
+    acc, m_prev, l_prev = carry
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale  # (..., Tq, Tk)
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -1e30)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    scale_prev = jnp.exp(m_prev - m_new)
+    l_new = l_prev * scale_prev + jnp.sum(p, axis=-1)
+    acc = acc * scale_prev[..., None] + \
+        jnp.einsum("...qk,...kd->...qd", p, v)
+    return acc, m_new, l_new
+
+
+def local_attention(q, k, v, causal=False):
+    """Plain single-device scaled-dot-product attention.
+
+    q/k/v: (B, H, T, D). The reference's closest op is the unfused
+    attention math in src/operator/contrib/transformer.cc."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
+    """Sequence-parallel attention: q/k/v are (B, H, T, D) GLOBAL arrays
+    sharded on T over `axis_name`. Returns output with the same sharding.
+
+    Inside shard_map each device sees its local (B, H, T/n, D) block;
+    K/V rotate n times around the ring via ppermute. Communication
+    overlaps with the per-block attention compute (XLA schedules the
+    ppermute DMA concurrently on ICI).
+    """
+    n = mesh.shape[axis_name]
+    spec = P(None, None, axis_name, None)
+
+    def local_fn(ql, kl, vl):
+        scale = 1.0 / jnp.sqrt(ql.shape[-1]).astype(jnp.float32)
+        my = lax.axis_index(axis_name)
+        Tq = ql.shape[2]
+        qf = ql.astype(jnp.float32)
+        acc = jnp.zeros(qf.shape, jnp.float32)
+        m = jnp.full(qf.shape[:-1], -1e30, jnp.float32)
+        l = jnp.zeros(qf.shape[:-1], jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def body(i, state):
+            kl_i, vl_i, acc, m, l = state
+            # kv block i hops: device holds block (my - i) mod n
+            src_blk = (my - i) % n
+            if causal:
+                # global positions: q row r_g = my*Tq + r;
+                # kv col c_g = src_blk*Tk + c; mask c_g <= r_g
+                Tk = kl_i.shape[2]
+                r_g = my * Tq + jnp.arange(Tq)
+                c_g = src_blk * Tk + jnp.arange(Tk)
+                mask = c_g[None, :] <= r_g[:, None]
+                mask = mask[None, None]
+            else:
+                mask = None
+            acc, m, l = _block_attn(qf, kl_i.astype(jnp.float32),
+                                    vl_i.astype(jnp.float32),
+                                    scale, (acc, m, l), mask)
+            kl_n = lax.ppermute(kl_i, axis_name, perm)
+            vl_n = lax.ppermute(vl_i, axis_name, perm)
+            return kl_n, vl_n, acc, m, l
+
+        state = (kl, vl, acc, m, l)
+        state = lax.fori_loop(0, n, body, state)
+        _, _, acc, m, l = state
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(ql.dtype)
+
+    fn = shard_map_compat(local_fn, mesh, (spec, spec, spec), spec)
+    return fn(q, k, v)
+
+
+class RingAttention:
+    """Callable wrapper binding a mesh/axis (gluon-friendly functional
+    block; integrates with ShardedTrainer via a custom op if traced)."""
+
+    def __init__(self, mesh, axis_name="sp", causal=False):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def __call__(self, q, k, v):
+        from ..ndarray import NDArray
+        unwrap = lambda x: x._data if isinstance(x, NDArray) else x
+        out = ring_attention(unwrap(q), unwrap(k), unwrap(v), self.mesh,
+                             self.axis_name, self.causal)
+        return NDArray(out) if isinstance(q, NDArray) else out
