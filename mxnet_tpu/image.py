@@ -1,0 +1,483 @@
+"""Image IO and augmentation (python-side pipeline).
+
+Reference: python/mxnet/image/image.py (~2.2k LoC): imdecode, resize_short,
+fixed_crop, random_crop, center_crop, color_normalize, Augmenter classes,
+CreateAugmenter, ImageIter.
+
+TPU notes: augmentation runs on host numpy (as the reference runs it on
+CPU via OpenCV); only the collated batch reaches the device. PIL plays
+OpenCV's role; raw-numpy .npy records work without PIL.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+import random as _random
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from . import ndarray as nd
+from . import recordio
+from .io import DataIter, DataBatch, DataDesc
+
+__all__ = ["imdecode", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug", "RandomOrderAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "RandomSizedCropAug", "HorizontalFlipAug", "CastAug",
+           "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
+
+
+def _np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=1, out=None):
+    """Decode an image byte buffer to an HWC NDArray
+    (reference: image.py:imdecode, backed by src/io/image_io.cc)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    data = bytes(buf)
+    if data[:4] == b"NPY0":
+        img = np.load(_pyio.BytesIO(data[4:]))
+    else:
+        try:
+            from PIL import Image
+            img = Image.open(_pyio.BytesIO(data))
+            img = img.convert("RGB" if flag else "L")
+            img = np.asarray(img)
+        except ImportError as e:
+            raise MXNetError(
+                "imdecode needs PIL for compressed images; pack with "
+                "recordio.pack_img's .npy fallback instead") from e
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return array(img)
+
+
+def imresize(src, w, h, interp=1):
+    img = _np(src)
+    try:
+        from PIL import Image
+        out = np.asarray(Image.fromarray(img.squeeze().astype(np.uint8))
+                         .resize((w, h), Image.BILINEAR))
+        if out.ndim == 2:
+            out = out[:, :, None]
+    except ImportError:
+        import jax
+        out = np.asarray(jax.image.resize(
+            img.astype(np.float32), (h, w) + img.shape[2:],
+            method="linear")).astype(img.dtype)
+    return array(out)
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit in src_size (reference: image.py)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to size (reference: image.py)."""
+    img = _np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(array(out), size[0], size[1], interp)
+    return array(out)
+
+
+def random_crop(src, size, interp=2):
+    img = _np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _random.randint(0, w - new_w)
+    y0 = _random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = _np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    img = _np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _random.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(_random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _random.randint(0, w - new_w)
+            y0 = _random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    img = _np(src).astype(np.float32)
+    img = img - _np(mean)
+    if std is not None:
+        img = img / _np(std)
+    return array(img)
+
+
+class Augmenter:
+    """Image augmenter base (reference: image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            return array(_np(src)[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return array(_np(src).astype(self.typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.brightness, self.brightness)
+        return array(_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.contrast, self.contrast)
+        img = _np(src).astype(np.float32)
+        gray = (img * self._coef).sum() * 3.0 / img.size
+        return array(img * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.saturation, self.saturation)
+        img = _np(src).astype(np.float32)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return array(img * alpha + gray * (1 - alpha))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)) \
+            .astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return array(_np(src).astype(np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32) \
+            if mean is not None else None
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Create an augmenter list (reference: image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator with augmentation over .rec files or path lists
+    (reference: image.py ImageIter; C++ twin iter_image_recordio_2.cc)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                     "r")
+            self.seq = list(self.imgrec.keys)
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        key = int(parts[0])
+                        label = np.asarray(parts[1:-1], np.float32)
+                        self.imglist[key] = (label, os.path.join(
+                            path_root, parts[-1]))
+                        self.seq.append(key)
+            else:
+                for i, rec in enumerate(imglist):
+                    label = np.asarray(rec[0], np.float32).reshape(-1)
+                    self.imglist[i] = (label, os.path.join(path_root,
+                                                           rec[1]))
+                    self.seq.append(i)
+        else:
+            raise MXNetError(
+                "ImageIter needs path_imgrec, path_imglist or imglist")
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise")})
+        self.auglist = aug_list
+        self.cur = 0
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, label_width)
+                                       if label_width > 1
+                                       else (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            _random.shuffle(self.seq)
+        self.cur = 0
+        if self.imgrec is not None:
+            self.imgrec.reset()
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            s = self.imgrec.read_idx(idx)
+            header, img = recordio.unpack(s)
+            label = header.label
+            return label, img
+        label, fname = self.imglist[idx]
+        with open(fname, "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              np.float32)
+        lshape = self.provide_label[0].shape
+        batch_label = np.zeros(lshape, np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = _np(img)
+                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_label[i] = np.asarray(label, np.float32).reshape(
+                    batch_label[i].shape) if self.label_width > 1 \
+                    else float(np.asarray(label).ravel()[0])
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        return DataBatch(data=[array(batch_data)],
+                         label=[array(batch_label)], pad=pad,
+                         index=None)

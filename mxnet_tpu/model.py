@@ -119,3 +119,150 @@ def load_params(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy training API (reference: model.py:555 FeedForward).
+
+    Deprecated in the reference in favor of Module; provided for API
+    parity and implemented as a thin driver over mxnet_tpu.module.Module.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as _init
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else _init.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs.copy()
+        self._module = None
+
+    def _as_iter(self, X, y=None, is_train=True):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        bs = min(self.numpy_batch_size, len(X))
+        return NDArrayIter(X, y, batch_size=bs, shuffle=is_train)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Train the model (reference: model.py:744)."""
+        from .module import Module
+        data = self._as_iter(X, y)
+        label_names = [d.name for d in (data.provide_label or [])] or None
+        self._module = Module(self.symbol, label_names=label_names,
+                              context=self.ctx)
+        self._module.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=self.kwargs or {"learning_rate": 0.01},
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch or 1, monitor=monitor,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def _ensure_module(self, data, for_training=False):
+        if self._module is None:
+            from .module import Module
+            label_names = [d.name for d in
+                           (data.provide_label or [])] or None
+            self._module = Module(self.symbol, label_names=label_names,
+                                  context=self.ctx)
+            self._module.bind(data_shapes=data.provide_data,
+                              label_shapes=data.provide_label or None,
+                              for_training=for_training)
+            self._module.set_params(self.arg_params or {},
+                                    self.aux_params or {})
+        return self._module
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Run prediction (reference: model.py:630). With
+        return_data=True also returns the consumed data and labels."""
+        data = self._as_iter(X, is_train=False)
+        mod = self._ensure_module(data)
+        if reset:
+            data.reset()
+        if not return_data:
+            outs = mod.predict(data, num_batch=num_batch)
+            if isinstance(outs, list):
+                return [o.asnumpy() for o in outs]
+            return outs.asnumpy()
+        outputs, datas, labels = [], [], []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            outputs.append(mod.get_outputs()[0].asnumpy())
+            datas.append(batch.data[0].asnumpy())
+            labels.append(batch.label[0].asnumpy()
+                          if batch.label else None)
+        import numpy as _npmod
+        return (_npmod.concatenate(outputs), _npmod.concatenate(datas),
+                _npmod.concatenate(labels)
+                if labels and labels[0] is not None else None)
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Evaluate; returns the metric value (reference: model.py:673)."""
+        data = self._as_iter(X, is_train=False)
+        mod = self._ensure_module(data)
+        res = list(mod.score(data, eval_metric, num_batch=num_batch))
+        # Module.score keys by the metric's display name; return the
+        # value (single metric) or the name->value dict (composite)
+        if len(res) == 1:
+            return res[0][1]
+        return dict(res)
+
+    def save(self, prefix, epoch=None):
+        """Checkpoint model (reference: model.py:964)."""
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Load a checkpointed model (reference: model.py:996)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Create+train in one call (reference: model.py:1031)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model
+
+
+__all__.append("FeedForward")

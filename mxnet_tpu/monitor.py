@@ -1,0 +1,90 @@
+"""Monitor: per-op output/weight statistics during training.
+
+Reference: python/mxnet/monitor.py:33 — installs an executor monitor
+callback (MXExecutorSetMonitorCallback; invoked per-op in
+GraphExecutor::RunOps, graph_executor.cc:1631) printing stat_func of
+outputs every N batches. Note the reference disables op bulking when a
+monitor is installed; here the analog is that monitored executors run the
+unfused per-output path (the callback hooks Executor.forward outputs).
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Monitor outputs, weights and gradients for debugging
+    (reference: monitor.py:33)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return x.norm() / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all=False):
+        """Install the callback on an executor
+        (reference: monitor.py:87)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for the current batch
+        (reference: monitor.py:96)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collection; return stats (reference: monitor.py:107)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays or []):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in (exe.grad_dict or {}).items():
+                if array is not None and self.re_prog.match(name):
+                    self.queue.append((self.step, "grad_" + name,
+                                       self.stat_func(array)))
+        res = []
+        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort \
+            else self.queue
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ",".join(str(float(v.asscalar())
+                             if isinstance(v, NDArray) else v)
+                         for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """End collection and print stats (reference: monitor.py:139)."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))

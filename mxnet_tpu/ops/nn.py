@@ -211,12 +211,13 @@ def _make_loss_core(x, grad_scale):
 
 
 def _make_loss_fwd(x, grad_scale):
-    return x, (x.shape, x.dtype)
+    # residuals must be JAX types (no np.dtype leaves); shape/dtype come
+    # from the cotangent itself in bwd
+    return x, None
 
 
 def _make_loss_bwd(grad_scale, res, g):
-    shape, dtype = res
-    return (jnp.full(shape, grad_scale, dtype=dtype),)
+    return (jnp.full_like(g, grad_scale),)
 
 
 _make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)

@@ -35,7 +35,7 @@ from ..ndarray import NDArray
 from .. import symbol as _sym
 from ..graph import build_graph_fn, collect_vars
 from .. import random as _random
-from .mesh import make_mesh, replicated
+from .mesh import make_mesh, replicated, current_mesh
 
 __all__ = ["ShardedTrainer", "sgd_init", "sgd_update", "adam_init",
            "adam_update"]
@@ -99,6 +99,8 @@ class ShardedTrainer:
                  data_names=("data",), label_names=("label",),
                  aux_mode="train"):
         self._net = net
+        if mesh is None:
+            mesh = current_mesh()  # use_mesh() scope, if any
         self._mesh = mesh if mesh is not None else make_mesh()
         self._batch_axis = batch_axis
         self._data_names = tuple(data_names)

@@ -56,8 +56,14 @@ def make_mesh(axes=None, devices=None):
     names = list(axes.keys())
     sizes = list(axes.values())
     n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError("make_mesh: at most one axis may be -1")
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(
+                "make_mesh: %d devices not divisible by fixed axes %s"
+                % (n, dict(zip(names, sizes))))
         sizes[sizes.index(-1)] = n // known
     total = int(np.prod(sizes))
     if total > n:

@@ -1,0 +1,47 @@
+"""Runtime compilation of custom kernels.
+
+Reference: python/mxnet/rtc.py (CudaModule :42 — NVRTC-compiled CUDA
+kernels callable on NDArrays, backed by src/common/rtc.cc).
+
+TPU-native equivalent: runtime-defined kernels are Pallas kernels (see
+ops/pallas_kernels.py) or jax-traced Python — there is no on-device C
+source compiler. CudaModule is kept as an API shim that raises with the
+migration hint, mirroring how the reference raises when built without
+USE_CUDA.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "PallasModule"]
+
+
+class CudaModule:
+    """Unsupported on TPU (reference: rtc.py:42)."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CUDA runtime compilation is not available on the TPU "
+            "backend. Write the kernel as a Pallas kernel "
+            "(mxnet_tpu.ops.pallas_kernels) or as a jax-traced function "
+            "registered with mxnet_tpu.ops.register().")
+
+
+class PallasModule:
+    """Register a user Pallas/JAX kernel as an operator at runtime —
+    the TPU analog of rtc.CudaModule.
+
+    Example::
+
+        mod = PallasModule(my_jax_fn, name="my_op")
+        y = mx.nd.my_op(x)
+    """
+
+    def __init__(self, fn, name, num_outputs=1):
+        from .ops import registry as _reg
+        self.name = name
+        _reg.register(name, num_outputs=num_outputs)(fn)
+        import mxnet_tpu.ndarray as _nd
+        import mxnet_tpu.symbol as _sym
+        _nd._refresh_namespaces()
+        _sym._refresh_namespaces()

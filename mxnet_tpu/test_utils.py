@@ -1,0 +1,367 @@
+"""Testing utilities — the backbone of the operator test strategy.
+
+Reference: python/mxnet/test_utils.py (1,951 LoC): assert_almost_equal
+:470, check_numeric_gradient :792, check_symbolic_forward :925,
+check_symbolic_backward :999, check_consistency :1207, rand_ndarray :339,
+default_context :53, simple_forward.
+
+TPU translation (SURVEY.md §4.2): check_consistency runs the same symbol
+under different contexts/dtypes (cpu vs accelerator, fp32 vs bf16/fp16)
+with tolerance tiers per dtype, replacing the reference's CPU↔GPU
+comparison.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .symbol import Symbol
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_shape_nd", "rand_ndarray",
+           "random_arrays", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "simple_forward", "numeric_grad",
+           "default_dtype", "rand_sparse_ndarray"]
+
+_default_ctx = None
+
+
+def default_context():
+    """Current default context for tests (reference: test_utils.py:53)."""
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    return np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Asserts element-wise closeness (reference: test_utils.py:470)."""
+    a, b = _as_np(a), _as_np(b)
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        rel = np.abs(a - b) / (np.abs(b) + atol)
+        idx = np.unravel_index(np.argmax(rel), rel.shape) if rel.size \
+            else ()
+        raise AssertionError(
+            "Items are not equal (rtol=%g, atol=%g):\n max rel err %g at "
+            "%s: %s=%r vs %s=%r" % (
+                rtol, atol, float(np.max(rel)) if rel.size else 0.0, idx,
+                names[0], a[idx] if rel.size else a,
+                names[1], b[idx] if rel.size else b))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def random_arrays(*shapes):
+    """Generate float32 numpy arrays (reference: test_utils.py:214)."""
+    arrays = [np.array(np.random.randn(), dtype=default_dtype())
+              if len(s) == 0 else
+              np.random.randn(*s).astype(default_dtype()) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None):
+    """Random NDArray, dense or sparse (reference: test_utils.py:339)."""
+    dtype = dtype or default_dtype()
+    if stype == "default":
+        return array(np.random.uniform(size=shape).astype(dtype), ctx=ctx)
+    return rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
+    """Random sparse NDArray (reference: test_utils.py:197)."""
+    density = 0.1 if density is None else density
+    dtype = dtype or default_dtype()
+    dense = np.random.uniform(size=shape).astype(dtype)
+    if stype == "row_sparse":
+        keep = np.random.uniform(size=shape[0]) < density
+        dense[~keep] = 0
+        arr = array(dense).tostype("row_sparse")
+        return arr, (arr.indices.asnumpy(), arr.data.asnumpy())
+    if stype == "csr":
+        keep = np.random.uniform(size=shape) < density
+        dense[~keep] = 0
+        arr = array(dense).tostype("csr")
+        return arr, (arr.indptr.asnumpy(), arr.indices.asnumpy(),
+                     arr.data.asnumpy())
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol with keyword ndarray inputs
+    (reference: test_utils.py:745)."""
+    outputs = sym.eval(ctx=ctx, **{k: array(v) for k, v in inputs.items()})
+    outputs = [o.asnumpy() for o in outputs]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Finite-difference gradients of executor's scalar-summed output
+    (reference: test_utils.py:754)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.copy()
+        grad = np.zeros_like(base)
+        flat = base.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            fp = sum(float(o.asnumpy().astype(np.float64).sum())
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = old - eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            fm = sum(float(o.asnumpy().astype(np.float64).sum())
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = old
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            gflat[i] = (fp - fm) / (2 * eps)
+        grads[name] = grad
+    return grads
+
+
+def _parse_location(sym, location, ctx=None):
+    if isinstance(location, dict):
+        return {k: (v if isinstance(v, NDArray) else array(v, ctx=ctx))
+                for k, v in location.items()}
+    return {k: (v if isinstance(v, NDArray) else array(v, ctx=ctx))
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True,
+                           ctx=None, grad_stype_dict=None, dtype=None):
+    """Finite differences vs autograd gradients
+    (reference: test_utils.py:792)."""
+    location = _parse_location(sym, location, ctx)
+    loc_np = {k: v.asnumpy().astype(np.float64)
+              for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = list(location.keys())
+
+    # random projection to a scalar: sum(out * proj)
+    proj = sym_mod.var("__random_proj")
+    out = sym_mod.make_loss(sym_mod.sum(sym * proj))
+    out_shapes = sym.infer_shape(
+        **{k: v.shape for k, v in location.items()})[1]
+    proj_val = np.random.uniform(-1, 1,
+                                 size=out_shapes[0]).astype(np.float64)
+
+    args = dict(location)
+    args["__random_proj"] = array(proj_val.astype(np.float32), ctx=ctx)
+    grad_req = {k: ("write" if k in grad_nodes else "null")
+                for k in location}
+    grad_req["__random_proj"] = "null"
+    executor = out.bind(ctx or default_context(), args=args,
+                        args_grad={
+                            k: nd.zeros(v.shape)
+                            for k, v in location.items()
+                            if k in grad_nodes},
+                        grad_req=grad_req,
+                        aux_states=aux_states)
+    executor.forward(is_train=True)
+    executor.backward()
+    sym_grads = {k: executor.grad_dict[k].asnumpy()
+                 for k in grad_nodes}
+
+    # numeric: perturb each grad node, reusing ONE executor (each forward
+    # is the same compiled XLA program with new inputs)
+    eps = numeric_eps
+    atol = atol if atol is not None else 1e-4
+    num_ex = out.bind(ctx or default_context(),
+                      args={**{k: array(v.astype(np.float32))
+                               for k, v in loc_np.items()},
+                            "__random_proj": args["__random_proj"]},
+                      aux_states=aux_states, grad_req="null")
+
+    def f(name, arr):
+        outs = num_ex.forward(is_train=use_forward_train,
+                              **{name: array(arr.astype(np.float32))})
+        return float(outs[0].asnumpy().astype(np.float64).sum())
+
+    for name in grad_nodes:
+        base = loc_np[name].copy()
+        num = np.zeros_like(base)
+        flat, nflat = base.ravel(), num.ravel()
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            fp = f(name, base)
+            flat[i] = old - eps
+            fm = f(name, base)
+            flat[i] = old
+            nflat[i] = (fp - fm) / (2 * eps)
+        num_ex.forward(is_train=use_forward_train,
+                       **{name: array(base.astype(np.float32))})
+        assert_almost_equal(num, sym_grads[name], rtol=rtol, atol=atol,
+                            names=("numeric_%s" % name,
+                                   "autograd_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, dtype=None,
+                           equal_nan=False):
+    """Compares forward outputs against expected arrays
+    (reference: test_utils.py:925)."""
+    location = _parse_location(sym, location, ctx)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    executor = sym.bind(ctx or default_context(), args=dict(location),
+                        aux_states=aux_states, grad_req="null")
+    outputs = [o.asnumpy() for o in executor.forward(is_train=False)]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            equal_nan=equal_nan)
+    return executor.outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-5, atol=None, aux_states=None,
+                            grad_req="write", ctx=None, grad_stypes=None,
+                            equal_nan=False, dtype=None):
+    """Compares autograd gradients against expected arrays
+    (reference: test_utils.py:999)."""
+    location = _parse_location(sym, location, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    args_grad = {k: nd.zeros(v.shape) for k, v in location.items()
+                 if k in expected}
+    req = {k: (grad_req if isinstance(grad_req, str) else
+               grad_req.get(k, "null")) if k in expected else "null"
+           for k in location}
+    executor = sym.bind(ctx or default_context(), args=dict(location),
+                        args_grad=args_grad, grad_req=req,
+                        aux_states=aux_states)
+    executor.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [array(g) if not isinstance(g, NDArray) else g
+                     for g in out_grads]
+    executor.backward(out_grads)
+    for name, exp in expected.items():
+        assert_almost_equal(executor.grad_dict[name].asnumpy(), exp,
+                            rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            names=("grad_%s" % name, "expected"),
+                            equal_nan=equal_nan)
+    return executor.grad_arrays
+
+
+# tolerance tiers per dtype (reference check_consistency's tol dict,
+# test_utils.py:1207; bf16 tier added for TPU)
+_DTYPE_TOL = {np.dtype(np.float16): 1e-1,
+              np.dtype(np.float32): 1e-3,
+              np.dtype(np.float64): 1e-5}
+try:
+    import jax.numpy as _jnp
+    _DTYPE_TOL[np.dtype(_jnp.bfloat16)] = 5e-2
+except Exception:  # pragma: no cover
+    pass
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None,
+                      equal_nan=False):
+    """Run one symbol under several contexts/dtypes and compare
+    (reference: test_utils.py:1207). ctx_list entries are dicts like
+    {'ctx': mx.cpu(), 'data': (2,3), 'type_dict': {'data': np.float32}}.
+    """
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+
+    output_points = None
+    results = []
+    base_args = None
+    for s, ctx_info in zip(sym, ctx_list):
+        ctx_info = dict(ctx_info)
+        ctx = ctx_info.pop("ctx", None) or default_context()
+        type_dict = ctx_info.pop("type_dict", {})
+        shapes = ctx_info
+        arg_names = s.list_arguments()
+        if base_args is None:
+            np.random.seed(0)
+            base_args = {n: (np.random.normal(size=shapes[n]) * scale)
+                         .astype(np.float64)
+                         for n in arg_names if n in shapes}
+            if arg_params:
+                for k, v in arg_params.items():
+                    base_args[k] = _as_np(v).astype(np.float64)
+        # args without an explicit dtype follow the entry's narrowest
+        # specified dtype (the reference casts whole executors per ctx)
+        if type_dict:
+            default_dt = min((np.dtype(d) for d in type_dict.values()),
+                             key=lambda d: d.itemsize)
+        else:
+            default_dt = np.dtype(np.float32)
+        args = {}
+        for n in arg_names:
+            if n not in base_args:
+                continue
+            dt = np.dtype(type_dict.get(n, default_dt))
+            args[n] = array(base_args[n].astype(
+                np.float32 if dt.itemsize < 4 else dt).astype(dt),
+                ctx=ctx, dtype=dt)
+        ex = s.bind(ctx, args=args, grad_req="null")
+        outs = [o.asnumpy().astype(np.float64)
+                for o in ex.forward(is_train=False)]
+        results.append((outs, type_dict))
+
+    gt = ground_truth if ground_truth is not None else results[0][0]
+    for i, (outs, type_dict) in enumerate(results):
+        t = max((_DTYPE_TOL.get(np.dtype(d), 1e-3)
+                 for d in type_dict.values()), default=1e-3) \
+            if tol is None else tol
+        for o, g in zip(outs, gt):
+            try:
+                assert_almost_equal(o, g, rtol=t, atol=t,
+                                    equal_nan=equal_nan)
+            except AssertionError:
+                if raise_on_err:
+                    raise
+    return gt

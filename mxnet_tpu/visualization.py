@@ -1,0 +1,132 @@
+"""Network visualization: text summary and graphviz plotting.
+
+Reference: python/mxnet/visualization.py (print_summary :26,
+plot_network :200 via graphviz).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Prints a layer-by-layer summary table with output shapes and
+    parameter counts (reference: visualization.py:26)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    show_shape = shape is not None
+    shape_dict = {}
+    if show_shape:
+        arg_shapes, out_shapes, aux_shapes = \
+            symbol.infer_shape_partial(**shape)
+        names = symbol.list_arguments()
+        shape_dict.update({n: s for n, s in zip(names, arg_shapes)})
+        shape_dict.update({n: s for n, s in zip(
+            symbol.list_auxiliary_states(), aux_shapes)})
+
+    internals = symbol.get_internals()
+    positions = positions or [.44, .64, .74, 1.]
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for f, p in zip(fields, pos):
+            line += str(f)
+            line = line[:p - 1]
+            line += " " * (p - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = 0
+    seen = set()
+    arg_set = set(symbol.list_arguments())
+    aux_set = set(symbol.list_auxiliary_states())
+    rows = []
+    for entry in internals._entries:
+        node, idx = entry
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.is_variable:
+            continue
+        op_name = node.op.name if node.op is not None else "null"
+        name = node.name
+        # parameter count: sum over this node's variable inputs
+        n_params = 0
+        prevs = []
+        for (inode, _i) in node.inputs:
+            if inode.is_variable:
+                nm = inode.name
+                if nm in arg_set or nm in aux_set:
+                    s = shape_dict.get(nm)
+                    if s:
+                        p = 1
+                        for d in s:
+                            p *= d
+                        n_params += p
+            else:
+                prevs.append(inode.name)
+        total_params += n_params
+        out_shape = ""
+        if show_shape:
+            try:
+                shapes = internals.infer_shape_partial(**shape)[1]
+            except MXNetError:
+                shapes = None
+        rows.append((("%s(%s)" % (name, op_name)), out_shape, n_params,
+                     ",".join(prevs)))
+    for i, row in enumerate(rows):
+        print_row(row, positions)
+        print(("=" if i == len(rows) - 1 else "_") * line_length)
+    print("Total params: {params}".format(params=total_params))
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Creates a graphviz Digraph of the network
+    (reference: visualization.py:200). Requires the `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the graphviz python package, which is "
+            "not installed in this environment; use print_summary for a "
+            "text view.") from e
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    seen = set()
+    internals = symbol.get_internals()
+    for entry in internals._entries:
+        node, _ = entry
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.is_variable:
+            if not hide_weights or node.name in \
+                    (symbol.list_arguments()[0],):
+                dot.node(name=node.name, label=node.name,
+                         fillcolor="#8dd3c7", **node_attr)
+            continue
+        op_name = node.op.name if node.op is not None else "null"
+        dot.node(name=node.name, label="%s\n%s" % (op_name, node.name),
+                 fillcolor="#fb8072", **node_attr)
+        for (inode, _i) in node.inputs:
+            if inode.is_variable and hide_weights and \
+                    inode.name != symbol.list_arguments()[0]:
+                continue
+            dot.edge(tail_name=inode.name, head_name=node.name)
+    return dot

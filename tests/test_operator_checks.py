@@ -1,0 +1,147 @@
+"""Operator correctness via the generic checkers.
+
+Mirrors the reference's test strategy (SURVEY.md §4.1): finite-difference
+gradients vs autograd (check_numeric_gradient), forward/backward vs
+closed-form (check_symbolic_*), and cross-dtype consistency
+(check_consistency) — the backbone of
+tests/python/unittest/test_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward,
+                                  check_consistency, assert_almost_equal)
+
+
+def _rand(*shape):
+    return np.random.RandomState(hash(shape) % 2**31).uniform(
+        -1, 1, size=shape).astype("float32")
+
+
+@pytest.mark.parametrize("op,np_fn,lo,hi", [
+    ("tanh", np.tanh, -2, 2),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), -2, 2),
+    ("exp", np.exp, -1, 1),
+    ("log", np.log, 0.2, 3),
+    ("sqrt", np.sqrt, 0.2, 3),
+    ("square", np.square, -2, 2),
+    ("abs", np.abs, 0.2, 2),
+])
+def test_unary_grad(op, np_fn, lo, hi):
+    x = np.random.uniform(lo, hi, size=(3, 4)).astype("float32")
+    s = mx.sym.var("x")
+    out = getattr(mx.sym, op)(s)
+    check_symbolic_forward(out, {"x": x}, [np_fn(x)], rtol=1e-4,
+                           atol=1e-5)
+    check_numeric_gradient(out, {"x": x}, numeric_eps=1e-3, rtol=5e-2,
+                           atol=1e-2)
+
+
+@pytest.mark.parametrize("op", ["broadcast_add", "broadcast_mul",
+                                "broadcast_sub", "broadcast_div"])
+def test_binary_broadcast_grad(op):
+    a = _rand(3, 1, 4) + 1.5
+    b = _rand(1, 2, 4) + 1.5
+    sa, sb = mx.sym.var("a"), mx.sym.var("b")
+    out = getattr(mx.sym, op)(sa, sb)
+    check_numeric_gradient(out, {"a": a, "b": b}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-2)
+
+
+def test_fully_connected_grad():
+    check_numeric_gradient(
+        mx.sym.FullyConnected(mx.sym.var("data"), mx.sym.var("w"),
+                              mx.sym.var("b"), num_hidden=3),
+        {"data": _rand(2, 5), "w": _rand(3, 5), "b": _rand(3)},
+        numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_convolution_grad():
+    check_numeric_gradient(
+        mx.sym.Convolution(mx.sym.var("data"), mx.sym.var("w"),
+                           mx.sym.var("b"), kernel=(2, 2), num_filter=2),
+        {"data": _rand(1, 2, 4, 4), "w": _rand(2, 2, 2, 2),
+         "b": _rand(2)},
+        numeric_eps=1e-3, rtol=5e-2, atol=2e-2)
+
+
+def test_pooling_grad():
+    for ptype in ("max", "avg"):
+        check_numeric_gradient(
+            mx.sym.Pooling(mx.sym.var("data"), kernel=(2, 2),
+                           stride=(2, 2), pool_type=ptype),
+            {"data": _rand(1, 2, 4, 4)},
+            numeric_eps=1e-3, rtol=5e-2, atol=2e-2)
+
+
+def test_softmax_grad():
+    check_numeric_gradient(
+        mx.sym.softmax(mx.sym.var("x")), {"x": _rand(3, 5)},
+        numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_layernorm_grad():
+    check_numeric_gradient(
+        mx.sym.LayerNorm(mx.sym.var("x"), mx.sym.var("g"),
+                         mx.sym.var("b")),
+        {"x": _rand(3, 6), "g": _rand(6) + 1.5, "b": _rand(6)},
+        numeric_eps=1e-3, rtol=5e-2, atol=2e-2)
+
+
+def test_dot_backward():
+    a = _rand(3, 4)
+    b = _rand(4, 5)
+    g = np.ones((3, 5), dtype="float32")
+    check_symbolic_backward(
+        mx.sym.dot(mx.sym.var("a"), mx.sym.var("b")),
+        {"a": a, "b": b}, [g],
+        {"a": g @ b.T, "b": a.T @ g}, rtol=1e-4, atol=1e-4)
+
+
+def test_consistency_fp16_fp32():
+    sym = mx.sym.Convolution(mx.sym.var("data"), mx.sym.var("w"),
+                             no_bias=True, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1))
+    check_consistency(sym, [
+        {"ctx": mx.cpu(), "data": (2, 3, 8, 8), "w": (4, 3, 3, 3),
+         "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (2, 3, 8, 8), "w": (4, 3, 3, 3),
+         "type_dict": {"data": np.float16}},
+    ])
+
+
+def test_embedding_take_grad():
+    w = _rand(7, 4)
+    idx = np.array([1, 3, 5], dtype="float32")
+    out = mx.sym.Embedding(mx.sym.var("idx"), mx.sym.var("w"),
+                           input_dim=7, output_dim=4)
+    g = np.ones((3, 4), dtype="float32")
+    expected_w = np.zeros_like(w)
+    for i in idx.astype(int):
+        expected_w[i] += 1
+    check_symbolic_backward(out, {"idx": idx, "w": w}, [g],
+                            {"w": expected_w}, rtol=1e-4, atol=1e-4,
+                            grad_req={"idx": "null", "w": "write"})
+
+
+def test_batchnorm_consistency_train_predict():
+    x = _rand(4, 3, 5, 5) * 2
+    gamma = np.ones(3, dtype="float32")
+    beta = np.zeros(3, dtype="float32")
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    out = mx.sym.BatchNorm(mx.sym.var("x"), mx.sym.var("g"),
+                           mx.sym.var("b"), mx.sym.var("mm"),
+                           mx.sym.var("mv"), fix_gamma=False, eps=1e-5)
+    expected = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-5)
+    ex = out.bind(mx.cpu(), args={"x": mx.nd.array(x),
+                                  "g": mx.nd.array(gamma),
+                                  "b": mx.nd.array(beta)},
+                  aux_states={"mm": mx.nd.zeros(3),
+                              "mv": mx.nd.ones(3)}, grad_req="null")
+    y = ex.forward(is_train=True)[0]
+    assert_almost_equal(y, expected, rtol=1e-3, atol=1e-3)
