@@ -1,0 +1,304 @@
+"""ctypes bindings for the native runtime (src/libmxtpu.so).
+
+Reference analog: python/mxnet/base.py's _load_lib + the ctypes calling
+layer. The native library provides the host-side threaded dependency
+engine (src/engine.cc, mirror of src/engine/threaded_engine.h semantics)
+and the RecordIO reader/writer + prefetching loader (src/recordio.cc).
+
+Everything degrades gracefully: if the library isn't built, `LIB` is
+None and callers fall back to pure-python paths. Build with
+`make -C src` (or ensure_built()).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["LIB", "ensure_built", "NativeEngine", "RecordReader",
+           "RecordWriter", "PrefetchLoader", "NativeError"]
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+_LIB_PATH = os.path.join(_SRC_DIR, "libmxtpu.so")
+
+LIB = None
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def _bind(lib):
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    lib.MXTEngineCreate.restype = ctypes.c_void_p
+    lib.MXTEngineCreate.argtypes = [ctypes.c_int]
+    lib.MXTEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineNewVar.restype = ctypes.c_int64
+    lib.MXTEngineNewVar.argtypes = [ctypes.c_void_p]
+    lib.MXTEnginePush.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.MXTEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.MXTEngineWaitForAll.argtypes = [ctypes.c_void_p]
+
+    lib.MXTRecordIOGetLastError.restype = ctypes.c_char_p
+    lib.MXTRecordReaderCreate.restype = ctypes.c_void_p
+    lib.MXTRecordReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordReaderFree.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordReaderNext.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.MXTRecordReaderReset.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordReaderTell.restype = ctypes.c_int64
+    lib.MXTRecordReaderTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.MXTRecordWriterCreate.restype = ctypes.c_void_p
+    lib.MXTRecordWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordWriterFree.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordWriterTell.restype = ctypes.c_int64
+    lib.MXTRecordWriterTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordWriterWrite.restype = ctypes.c_int64
+    lib.MXTRecordWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int64]
+    lib.MXTPrefetchLoaderCreate.restype = ctypes.c_void_p
+    lib.MXTPrefetchLoaderCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.MXTPrefetchLoaderFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPrefetchLoaderNext.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.MXTPrefetchBatchFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _try_load():
+    global LIB
+    if LIB is not None:
+        return LIB
+    if os.path.exists(_LIB_PATH):
+        try:
+            LIB = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            LIB = None
+    return LIB
+
+
+def ensure_built(quiet=True):
+    """Build libmxtpu.so if missing (CI convenience); returns LIB or
+    None."""
+    if _try_load() is not None:
+        return LIB
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR],
+                       check=True,
+                       stdout=subprocess.DEVNULL if quiet else None,
+                       stderr=subprocess.DEVNULL if quiet else None)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return None
+    return _try_load()
+
+
+_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """Host-side threaded dependency engine (src/engine.cc).
+
+    API mirror of the reference Engine (include/mxnet/engine.h:98):
+    new_variable / push(fn, const_vars, mutable_vars) / wait_for_var /
+    wait_for_all. Python callbacks run on native worker threads."""
+
+    def __init__(self, num_workers=4):
+        lib = _try_load()
+        if lib is None:
+            raise NativeError("libmxtpu.so not built; run make -C src")
+        self._lib = lib
+        self._h = lib.MXTEngineCreate(num_workers)
+        if not self._h:
+            raise NativeError(lib.MXTGetLastError().decode())
+        # keep callback objects alive until executed
+        self._cbs = {}
+        self._cb_lock = threading.Lock()
+        self._cb_id = 0
+
+    def new_variable(self):
+        return self._lib.MXTEngineNewVar(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        with self._cb_lock:
+            cb_id = self._cb_id
+            self._cb_id += 1
+
+        def trampoline(_arg, _id=cb_id):
+            try:
+                fn()
+            finally:
+                with self._cb_lock:
+                    self._cbs.pop(_id, None)
+
+        cb = _CB_TYPE(trampoline)
+        with self._cb_lock:
+            self._cbs[cb_id] = cb
+        cv = (ctypes.c_int64 * len(const_vars))(*const_vars)
+        mv = (ctypes.c_int64 * len(mutable_vars))(*mutable_vars)
+        ret = self._lib.MXTEnginePush(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            cv, len(const_vars), mv, len(mutable_vars))
+        if ret != 0:
+            raise NativeError(self._lib.MXTGetLastError().decode())
+
+    def wait_for_var(self, var):
+        if self._lib.MXTEngineWaitForVar(self._h, var) != 0:
+            raise NativeError(self._lib.MXTGetLastError().decode())
+
+    def wait_for_all(self):
+        if self._lib.MXTEngineWaitForAll(self._h) != 0:
+            raise NativeError(self._lib.MXTGetLastError().decode())
+
+    def close(self):
+        if self._h:
+            self._lib.MXTEngineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordReader:
+    """Sequential native RecordIO reader (src/recordio.cc)."""
+
+    def __init__(self, path):
+        lib = _try_load()
+        if lib is None:
+            raise NativeError("libmxtpu.so not built")
+        self._lib = lib
+        self._h = lib.MXTRecordReaderCreate(path.encode())
+        if not self._h:
+            raise NativeError(lib.MXTRecordIOGetLastError().decode())
+
+    def read(self):
+        out = ctypes.c_char_p()
+        size = ctypes.c_int64()
+        ret = self._lib.MXTRecordReaderNext(self._h, ctypes.byref(out),
+                                            ctypes.byref(size))
+        if ret == 1:
+            return None
+        if ret != 0:
+            raise NativeError(
+                self._lib.MXTRecordIOGetLastError().decode())
+        return ctypes.string_at(out, size.value)
+
+    def reset(self):
+        self._lib.MXTRecordReaderReset(self._h)
+
+    def tell(self):
+        return self._lib.MXTRecordReaderTell(self._h)
+
+    def seek(self, pos):
+        self._lib.MXTRecordReaderSeek(self._h, pos)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordWriter:
+    """Native RecordIO writer (src/recordio.cc)."""
+
+    def __init__(self, path):
+        lib = _try_load()
+        if lib is None:
+            raise NativeError("libmxtpu.so not built")
+        self._lib = lib
+        self._h = lib.MXTRecordWriterCreate(path.encode())
+        if not self._h:
+            raise NativeError(lib.MXTRecordIOGetLastError().decode())
+
+    def write(self, buf):
+        pos = self._lib.MXTRecordWriterWrite(self._h, bytes(buf),
+                                             len(buf))
+        if pos < 0:
+            raise NativeError(
+                self._lib.MXTRecordIOGetLastError().decode())
+        return pos
+
+    def tell(self):
+        return self._lib.MXTRecordWriterTell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordWriterFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PrefetchLoader:
+    """Background-threaded record batch loader (src/recordio.cc
+    PrefetchLoader; the iter_prefetcher.h role)."""
+
+    def __init__(self, path, batch_records, queue_cap=4, loop=False):
+        lib = _try_load()
+        if lib is None:
+            raise NativeError("libmxtpu.so not built")
+        self._lib = lib
+        self._h = lib.MXTPrefetchLoaderCreate(path.encode(),
+                                              batch_records, queue_cap,
+                                              1 if loop else 0)
+        if not self._h:
+            raise NativeError(lib.MXTRecordIOGetLastError().decode())
+
+    def next(self):
+        """Returns a list of record byte strings, or None at end."""
+        bh = ctypes.c_void_p()
+        by = ctypes.c_char_p()
+        nb = ctypes.c_int64()
+        offs = ctypes.POINTER(ctypes.c_int64)()
+        nr = ctypes.c_int64()
+        ret = self._lib.MXTPrefetchLoaderNext(
+            self._h, ctypes.byref(bh), ctypes.byref(by),
+            ctypes.byref(nb), ctypes.byref(offs), ctypes.byref(nr))
+        if ret == 1:
+            return None
+        raw = ctypes.string_at(by, nb.value)
+        offsets = [offs[i] for i in range(nr.value + 1)]
+        self._lib.MXTPrefetchBatchFree(bh)
+        return [raw[offsets[i]:offsets[i + 1]]
+                for i in range(nr.value)]
+
+    def __iter__(self):
+        while True:
+            batch = self.next()
+            if batch is None:
+                return
+            yield batch
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPrefetchLoaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

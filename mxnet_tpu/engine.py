@@ -70,3 +70,29 @@ def deterministic():
     finally:
         _nd_mod.invoke = orig_invoke
         _MODE["mode"] = prev
+
+
+# ---------------------------------------------------------------------------
+# Host-side native engine (src/engine.cc): the C++ threaded dependency
+# engine for HOST work — IO, decode, checkpoint writes — where XLA's
+# scheduler doesn't reach. Same push/var contract as the reference
+# (include/mxnet/engine.h:98).
+# ---------------------------------------------------------------------------
+_host_engine = None
+_host_engine_lock = threading.Lock()
+
+
+def host_engine(num_workers=None):
+    """Singleton native host engine, or None if the native lib isn't
+    built. new_variable()/push(fn, const_vars, mutable_vars)/
+    wait_for_var()/wait_for_all()."""
+    global _host_engine
+    with _host_engine_lock:
+        if _host_engine is None:
+            from . import _native
+            if _native.ensure_built() is None:
+                return None
+            n = num_workers or int(getenv("MXTPU_CPU_WORKER_NTHREADS",
+                                          "4"))
+            _host_engine = _native.NativeEngine(n)
+        return _host_engine

@@ -28,7 +28,8 @@ __all__ = ["imdecode", "imresize", "scale_down", "resize_short",
            "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
            "RandomSizedCropAug", "HorizontalFlipAug", "CastAug",
            "BrightnessJitterAug", "ContrastJitterAug",
-           "SaturationJitterAug", "ColorJitterAug", "LightingAug",
+           "SaturationJitterAug", "HueJitterAug", "RandomGrayAug",
+           "ColorJitterAug", "LightingAug",
            "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
 
 
@@ -38,22 +39,15 @@ def _np(img):
 
 def imdecode(buf, flag=1, to_rgb=1, out=None):
     """Decode an image byte buffer to an HWC NDArray
-    (reference: image.py:imdecode, backed by src/io/image_io.cc)."""
+    (reference: image.py:imdecode, backed by src/io/image_io.cc).
+    Delegates to recordio's decoder so .rec payloads decode identically
+    on both paths."""
     if isinstance(buf, NDArray):
         buf = buf.asnumpy().tobytes()
-    data = bytes(buf)
-    if data[:4] == b"NPY0":
-        img = np.load(_pyio.BytesIO(data[4:]))
-    else:
-        try:
-            from PIL import Image
-            img = Image.open(_pyio.BytesIO(data))
-            img = img.convert("RGB" if flag else "L")
-            img = np.asarray(img)
-        except ImportError as e:
-            raise MXNetError(
-                "imdecode needs PIL for compressed images; pack with "
-                "recordio.pack_img's .npy fallback instead") from e
+    try:
+        img = recordio._imdecode(bytes(buf), iscolor=1 if flag else 0)
+    except RuntimeError as e:
+        raise MXNetError(str(e)) from e
     if img.ndim == 2:
         img = img[:, :, None]
     return array(img)
@@ -302,6 +296,49 @@ class SaturationJitterAug(Augmenter):
         return array(img * alpha + gray * (1 - alpha))
 
 
+class HueJitterAug(Augmenter):
+    """Random hue jitter via the RGB rotation approximation the
+    reference uses (image.py HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = _random.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return array(np.dot(_np(src).astype(np.float32), t))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to grayscale (reference: image.py
+    RandomGrayAug)."""
+
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            return array(np.dot(_np(src).astype(np.float32), self._mat))
+        return src
+
+
 class ColorJitterAug(RandomOrderAug):
     def __init__(self, brightness, contrast, saturation):
         ts = []
@@ -362,6 +399,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if pca_noise > 0:
         eigval = np.array([55.46, 4.794, 1.148])
         eigvec = np.array([[-0.5675, 0.7192, 0.4009],
@@ -423,7 +464,8 @@ class ImageIter(DataIter):
                 k: v for k, v in kwargs.items()
                 if k in ("resize", "rand_crop", "rand_resize",
                          "rand_mirror", "mean", "std", "brightness",
-                         "contrast", "saturation", "pca_noise")})
+                         "contrast", "saturation", "hue", "pca_noise",
+                         "rand_gray", "inter_method")})
         self.auglist = aug_list
         self.cur = 0
         self.provide_data = [DataDesc(data_name,

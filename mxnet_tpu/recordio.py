@@ -24,8 +24,21 @@ _kMagic = 0xced7230a
 _LENGTH_MASK = (1 << 29) - 1
 
 
+def _native_lib():
+    try:
+        from . import _native
+        return _native.LIB if _native.LIB is not None \
+            else _native._try_load()
+    except Exception:
+        return None
+
+
 class MXRecordIO:
-    """Sequential RecordIO reader/writer (reference: recordio.py:37)."""
+    """Sequential RecordIO reader/writer (reference: recordio.py:37).
+
+    Uses the native C++ reader/writer (src/recordio.cc) when
+    libmxtpu.so is built, mirroring the reference's C++ RecordIO with a
+    python fallback."""
 
     def __init__(self, uri, flag):
         self.uri = uri
@@ -33,15 +46,25 @@ class MXRecordIO:
         self.handle = None
         self.writable = None
         self.is_open = False
+        self._nat = None
         self.open()
 
     def open(self):
+        lib = _native_lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if lib is not None:
+                from . import _native
+                self._nat = _native.RecordWriter(self.uri)
+            else:
+                self.handle = open(self.uri, "wb")
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if lib is not None:
+                from . import _native
+                self._nat = _native.RecordReader(self.uri)
+            else:
+                self.handle = open(self.uri, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
@@ -55,6 +78,7 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("handle", None)
+        d.pop("_nat", None)
         return d
 
     def __setstate__(self, d):
@@ -62,13 +86,19 @@ class MXRecordIO:
         is_open = d.get("is_open", False)
         self.is_open = False
         self.handle = None
+        self._nat = None
         if is_open:
             self.open()
 
     def close(self):
         if not self.is_open:
             return
-        self.handle.close()
+        if self._nat is not None:
+            self._nat.close()
+            self._nat = None
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
         self.is_open = False
 
     def reset(self):
@@ -78,24 +108,32 @@ class MXRecordIO:
 
     def tell(self):
         """Current position of the file head."""
+        if self._nat is not None:
+            return self._nat.tell()
         return self.handle.tell()
 
     def write(self, buf):
         """Appends one record (reference: recordio.py:154)."""
         assert self.writable
         data = bytes(buf)
+        if self._nat is not None:
+            return self._nat.write(data)
         upper = 0  # cflag 0: complete record (no multi-part split)
         lrec = (upper << 29) | (len(data) & _LENGTH_MASK)
+        pos = self.handle.tell()
         self.handle.write(struct.pack("<II", _kMagic, lrec))
         self.handle.write(data)
         pad = (4 - (len(data) % 4)) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
+        return pos
 
     def read(self):
         """Reads the next record; None at EOF
         (reference: recordio.py:180)."""
         assert not self.writable
+        if self._nat is not None:
+            return self._nat.read()
         hdr = self.handle.read(8)
         if len(hdr) < 8:
             return None
@@ -152,7 +190,10 @@ class MXIndexedRecordIO(MXRecordIO):
         """Sets read head to the record with the given key."""
         assert not self.writable
         pos = self.idx[idx]
-        self.handle.seek(pos)
+        if self._nat is not None:
+            self._nat.seek(pos)
+        else:
+            self.handle.seek(pos)
 
     def read_idx(self, idx):
         """Reads the record with the given key."""
@@ -162,8 +203,7 @@ class MXIndexedRecordIO(MXRecordIO):
     def write_idx(self, idx, buf):
         """Writes a record keyed by idx."""
         key = self.key_type(idx)
-        pos = self.tell()
-        self.write(buf)
+        pos = self.write(buf)
         self.fidx.write("%s\t%d\n" % (str(key), pos))
         self.idx[key] = pos
         self.keys.append(key)

@@ -325,8 +325,8 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         shapes = ctx_info
         arg_names = s.list_arguments()
         if base_args is None:
-            np.random.seed(0)
-            base_args = {n: (np.random.normal(size=shapes[n]) * scale)
+            rng = np.random.RandomState(0)  # do not clobber global RNG
+            base_args = {n: (rng.normal(size=shapes[n]) * scale)
                          .astype(np.float64)
                          for n in arg_names if n in shapes}
             if arg_params:

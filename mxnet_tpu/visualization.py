@@ -48,6 +48,16 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     seen = set()
     arg_set = set(symbol.list_arguments())
     aux_set = set(symbol.list_auxiliary_states())
+    # one shape-inference pass over the whole internals graph
+    node_shape = {}
+    if show_shape:
+        try:
+            int_shapes = internals.infer_shape_partial(**shape)[1]
+            for (n, i), s in zip(internals._entries, int_shapes):
+                if i == 0:
+                    node_shape[id(n)] = s
+        except MXNetError:
+            pass
     rows = []
     for entry in internals._entries:
         node, idx = entry
@@ -74,12 +84,7 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
             else:
                 prevs.append(inode.name)
         total_params += n_params
-        out_shape = ""
-        if show_shape:
-            try:
-                shapes = internals.infer_shape_partial(**shape)[1]
-            except MXNetError:
-                shapes = None
+        out_shape = str(node_shape.get(id(node), "") or "")
         rows.append((("%s(%s)" % (name, op_name)), out_shape, n_params,
                      ",".join(prevs)))
     for i, row in enumerate(rows):

@@ -1,0 +1,290 @@
+// Threaded dependency engine: the host-side async scheduler.
+//
+// Reference: src/engine/threaded_engine.{h,cc} — ThreadedVar's versioned
+// read/write queues (threaded_engine.h:115), ThreadedOpr (:224), dep
+// resolution AppendReadDependency/CompleteWriteDependency (:131-160),
+// worker pools (threaded_engine_perdevice.cc).
+//
+// TPU-native role: XLA/PJRT subsumes device-side scheduling, so this
+// engine schedules HOST work — record IO, decode/augment, checkpoint
+// writes, Python callbacks — with the same correctness contract as the
+// reference: ops push with const (read) and mutable (write) variable
+// sets; two ops without a conflict run concurrently; writes serialize
+// with reads per variable in push order.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+struct Opr;
+
+// A variable's scheduling state (mirror of ThreadedVar,
+// threaded_engine.h:99-219): reads between writes run concurrently,
+// writes serialize in push order.
+struct Var {
+  std::mutex m;
+  struct Block { Opr* opr; bool write; };
+  std::deque<Block> queue;   // blocked ops, in push order
+  int pending_reads = 0;     // running/dispatched reads
+  bool pending_write = false;  // a write is running/dispatched
+};
+
+struct Opr {
+  std::function<void()> fn;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mut_vars;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : shutdown_(false) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this]() { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(qm_);
+      shutdown_ = true;
+    }
+    qcv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(vm_);
+    Var* v = new Var();
+    all_vars_.push_back(v);
+    vars_[next_var_] = v;
+    return next_var_++;
+  }
+
+  void Push(std::function<void()> fn, const std::vector<int64_t>& cvars,
+            const std::vector<int64_t>& mvars) {
+    Opr* op = new Opr();
+    op->fn = std::move(fn);
+    {
+      std::lock_guard<std::mutex> lk(vm_);
+      for (int64_t id : cvars) op->const_vars.push_back(vars_.at(id));
+      for (int64_t id : mvars) op->mut_vars.push_back(vars_.at(id));
+    }
+    pending_.fetch_add(1);
+    // dependency registration (AppendRead/WriteDependency analog).
+    // wait starts at 1 so the op can't fire mid-registration.
+    op->wait.store(1 + (int)op->const_vars.size() +
+                   (int)op->mut_vars.size());
+    for (Var* v : op->const_vars) {
+      std::lock_guard<std::mutex> lk(v->m);
+      if (!v->pending_write && v->queue.empty()) {
+        v->pending_reads++;
+        DecWait(op);
+      } else {
+        v->queue.push_back({op, false});
+      }
+    }
+    for (Var* v : op->mut_vars) {
+      std::lock_guard<std::mutex> lk(v->m);
+      if (!v->pending_write && v->pending_reads == 0 &&
+          v->queue.empty()) {
+        v->pending_write = true;
+        DecWait(op);
+      } else {
+        v->queue.push_back({op, true});
+      }
+    }
+    DecWait(op);  // registration done
+  }
+
+  void WaitForVar(int64_t var) {
+    // push a read-only sync op and block until it runs
+    // (reference: ThreadedEngine::WaitForVar, threaded_engine.cc:367)
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    Push([&]() {
+      std::lock_guard<std::mutex> lk(m);
+      done = true;
+      cv.notify_all();
+    }, {var}, {});
+    std::unique_lock<std::mutex> lk(m);
+    cv.wait(lk, [&]() { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(done_m_);
+    done_cv_.wait(lk, [this]() { return pending_.load() == 0; });
+  }
+
+ private:
+  void DecWait(Opr* op) {
+    if (op->wait.fetch_sub(1) == 1) {
+      {
+        std::lock_guard<std::mutex> lk(qm_);
+        ready_.push(op);
+      }
+      qcv_.notify_one();
+    }
+  }
+
+  // CompleteReadDependency / CompleteWriteDependency analogs
+  // (threaded_engine.h:131-160): release this op's claim and wake
+  // whatever became unblocked.
+  void CompleteRead(Var* v) {
+    std::vector<Opr*> to_dec;
+    {
+      std::lock_guard<std::mutex> lk(v->m);
+      v->pending_reads--;
+      if (v->pending_reads == 0 && !v->queue.empty() &&
+          v->queue.front().write) {
+        Opr* w = v->queue.front().opr;
+        v->queue.pop_front();
+        v->pending_write = true;
+        to_dec.push_back(w);
+      }
+    }
+    for (Opr* o : to_dec) DecWait(o);
+  }
+
+  void CompleteWrite(Var* v) {
+    std::vector<Opr*> to_dec;
+    {
+      std::lock_guard<std::mutex> lk(v->m);
+      v->pending_write = false;
+      // release the next write, or every leading read
+      while (!v->queue.empty()) {
+        auto blk = v->queue.front();
+        if (blk.write) {
+          if (v->pending_reads == 0 && !v->pending_write) {
+            v->queue.pop_front();
+            v->pending_write = true;
+            to_dec.push_back(blk.opr);
+          }
+          break;
+        }
+        v->queue.pop_front();
+        v->pending_reads++;
+        to_dec.push_back(blk.opr);
+      }
+    }
+    for (Opr* o : to_dec) DecWait(o);
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(qm_);
+        qcv_.wait(lk, [this]() { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop();
+      }
+      op->fn();
+      for (Var* v : op->const_vars) CompleteRead(v);
+      for (Var* v : op->mut_vars) CompleteWrite(v);
+      delete op;
+      if (pending_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(done_m_);
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex vm_;
+  std::unordered_map<int64_t, Var*> vars_;
+  std::vector<Var*> all_vars_;
+  int64_t next_var_ = 1;
+
+  std::mutex qm_;
+  std::condition_variable qcv_;
+  std::queue<Opr*> ready_;
+  bool shutdown_;
+  std::vector<std::thread> workers_;
+
+  std::atomic<int64_t> pending_{0};
+  std::mutex done_m_;
+  std::condition_variable done_cv_;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// C ABI (reference: the engine slice of include/mxnet/c_api.h; error
+// convention = return int + MXTGetLastError, c_api_error.cc)
+// ---------------------------------------------------------------------------
+static thread_local std::string g_last_error;
+
+extern "C" {
+
+const char* MXTGetLastError() { return g_last_error.c_str(); }
+
+void* MXTEngineCreate(int num_workers) {
+  try {
+    return new mxtpu::Engine(num_workers);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+void MXTEngineFree(void* h) { delete static_cast<mxtpu::Engine*>(h); }
+
+int64_t MXTEngineNewVar(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->NewVar();
+}
+
+typedef void (*mxt_engine_cb)(void* arg);
+
+int MXTEnginePush(void* h, mxt_engine_cb fn, void* arg,
+                  const int64_t* cvars, int n_const,
+                  const int64_t* mvars, int n_mut) {
+  try {
+    std::vector<int64_t> cv(cvars, cvars + n_const);
+    std::vector<int64_t> mv(mvars, mvars + n_mut);
+    static_cast<mxtpu::Engine*>(h)->Push(
+        [fn, arg]() { fn(arg); }, cv, mv);
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXTEngineWaitForVar(void* h, int64_t var) {
+  try {
+    static_cast<mxtpu::Engine*>(h)->WaitForVar(var);
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXTEngineWaitForAll(void* h) {
+  try {
+    static_cast<mxtpu::Engine*>(h)->WaitForAll();
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+}  // extern "C"

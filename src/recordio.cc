@@ -1,0 +1,272 @@
+// RecordIO reader/writer + threaded prefetching loader.
+//
+// Reference: dmlc-core's recordio format (magic-framed, 4-byte aligned;
+// used by src/io/iter_image_recordio_2.cc) and the prefetcher
+// (src/io/iter_prefetcher.h). File-format compatible with the python
+// mxnet_tpu.recordio module and the reference's .rec files.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+
+static const uint32_t kMagic = 0xced7230a;
+static const uint32_t kLenMask = (1u << 29) - 1;
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path) {
+    f_ = std::fopen(path.c_str(), "rb");
+    if (!f_) throw std::runtime_error("cannot open " + path);
+  }
+  ~RecordReader() { if (f_) std::fclose(f_); }
+
+  // returns false at EOF; throws on corruption
+  bool Next(std::vector<char>* out) {
+    uint32_t hdr[2];
+    size_t n = std::fread(hdr, 1, 8, f_);
+    if (n < 8) return false;
+    if (hdr[0] != kMagic) throw std::runtime_error("bad recordio magic");
+    uint32_t len = hdr[1] & kLenMask;
+    out->resize(len);
+    if (len && std::fread(out->data(), 1, len, f_) != len)
+      throw std::runtime_error("truncated record");
+    uint32_t pad = (4 - (len % 4)) % 4;
+    if (pad) std::fseek(f_, pad, SEEK_CUR);
+    return true;
+  }
+
+  void Seek(long pos) { std::fseek(f_, pos, SEEK_SET); }
+  long Tell() { return std::ftell(f_); }
+  void Reset() { std::fseek(f_, 0, SEEK_SET); }
+
+ private:
+  std::FILE* f_;
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path) {
+    f_ = std::fopen(path.c_str(), "wb");
+    if (!f_) throw std::runtime_error("cannot open " + path);
+  }
+  ~RecordWriter() { if (f_) std::fclose(f_); }
+
+  long Write(const char* buf, uint32_t len) {
+    long pos = std::ftell(f_);
+    uint32_t hdr[2] = {kMagic, len & kLenMask};
+    std::fwrite(hdr, 1, 8, f_);
+    if (len) std::fwrite(buf, 1, len, f_);
+    static const char zeros[4] = {0, 0, 0, 0};
+    uint32_t pad = (4 - (len % 4)) % 4;
+    if (pad) std::fwrite(zeros, 1, pad, f_);
+    return pos;
+  }
+
+  long Tell() { return std::ftell(f_); }
+
+ private:
+  std::FILE* f_;
+};
+
+// Background prefetcher: a reader thread keeps a bounded queue of
+// record batches filled (iter_prefetcher.h's role). Each batch is a
+// flat byte buffer with an offsets table, handed to Python zero-copy
+// for decode (decode parallelism lives in the DataLoader workers).
+class PrefetchLoader {
+ public:
+  PrefetchLoader(const std::string& path, int batch_records,
+                 int queue_cap, bool loop)
+      : reader_(path), batch_(batch_records), cap_(queue_cap),
+        loop_(loop), eof_(false), stop_(false) {
+    th_ = std::thread([this]() { Loop(); });
+  }
+
+  ~PrefetchLoader() {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      stop_ = true;
+    }
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+    th_.join();
+  }
+
+  struct Batch {
+    std::vector<char> bytes;
+    std::vector<int64_t> offsets;  // n+1 entries
+  };
+
+  // returns nullptr at end of data (non-loop mode)
+  Batch* Next() {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_pop_.wait(lk, [this]() {
+      return !queue_.empty() || eof_ || stop_;
+    });
+    if (queue_.empty()) return nullptr;
+    Batch* b = queue_.front();
+    queue_.pop_front();
+    cv_push_.notify_one();
+    return b;
+  }
+
+ private:
+  void Loop() {
+    std::vector<char> rec;
+    for (;;) {
+      Batch* b = new Batch();
+      b->offsets.push_back(0);
+      for (int i = 0; i < batch_; ++i) {
+        bool ok;
+        try {
+          ok = reader_.Next(&rec);
+        } catch (...) {
+          ok = false;
+        }
+        if (!ok) {
+          if (loop_) {
+            reader_.Reset();
+            if (!reader_.Next(&rec)) { ok = false; }
+            else { ok = true; }
+          }
+        }
+        if (!ok) break;
+        b->bytes.insert(b->bytes.end(), rec.begin(), rec.end());
+        b->offsets.push_back((int64_t)b->bytes.size());
+      }
+      bool empty = b->offsets.size() <= 1;
+      if (empty) delete b;
+      std::unique_lock<std::mutex> lk(m_);
+      if (empty) {
+        eof_ = true;
+        cv_pop_.notify_all();
+        return;
+      }
+      cv_push_.wait(lk, [this]() {
+        return (int)queue_.size() < cap_ || stop_;
+      });
+      if (stop_) { delete b; return; }
+      queue_.push_back(b);
+      cv_pop_.notify_one();
+    }
+  }
+
+  RecordReader reader_;
+  int batch_;
+  int cap_;
+  bool loop_;
+  bool eof_;
+  bool stop_;
+  std::deque<Batch*> queue_;
+  std::mutex m_;
+  std::condition_variable cv_pop_, cv_push_;
+  std::thread th_;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+extern const char* MXTGetLastError();
+}
+// local error slot (shared symbol lives in engine.cc; keep a setter here)
+static thread_local std::string g_rio_error;
+static const char* set_err(const std::exception& e) {
+  g_rio_error = e.what();
+  return g_rio_error.c_str();
+}
+
+extern "C" {
+
+const char* MXTRecordIOGetLastError() { return g_rio_error.c_str(); }
+
+void* MXTRecordReaderCreate(const char* path) {
+  try { return new mxtpu::RecordReader(path); }
+  catch (const std::exception& e) { set_err(e); return nullptr; }
+}
+
+void MXTRecordReaderFree(void* h) {
+  delete static_cast<mxtpu::RecordReader*>(h);
+}
+
+// out/size are borrowed until the next call on this handle
+int MXTRecordReaderNext(void* h, const char** out, int64_t* size) {
+  static thread_local std::vector<char> buf;
+  try {
+    if (!static_cast<mxtpu::RecordReader*>(h)->Next(&buf)) return 1;
+    *out = buf.data();
+    *size = (int64_t)buf.size();
+    return 0;
+  } catch (const std::exception& e) { set_err(e); return -1; }
+}
+
+void MXTRecordReaderReset(void* h) {
+  static_cast<mxtpu::RecordReader*>(h)->Reset();
+}
+
+int64_t MXTRecordReaderTell(void* h) {
+  return static_cast<mxtpu::RecordReader*>(h)->Tell();
+}
+
+void MXTRecordReaderSeek(void* h, int64_t pos) {
+  static_cast<mxtpu::RecordReader*>(h)->Seek((long)pos);
+}
+
+void* MXTRecordWriterCreate(const char* path) {
+  try { return new mxtpu::RecordWriter(path); }
+  catch (const std::exception& e) { set_err(e); return nullptr; }
+}
+
+void MXTRecordWriterFree(void* h) {
+  delete static_cast<mxtpu::RecordWriter*>(h);
+}
+
+int64_t MXTRecordWriterTell(void* h) {
+  return static_cast<mxtpu::RecordWriter*>(h)->Tell();
+}
+
+int64_t MXTRecordWriterWrite(void* h, const char* buf, int64_t len) {
+  try {
+    return static_cast<mxtpu::RecordWriter*>(h)->Write(
+        buf, (uint32_t)len);
+  } catch (const std::exception& e) { set_err(e); return -1; }
+}
+
+void* MXTPrefetchLoaderCreate(const char* path, int batch_records,
+                              int queue_cap, int loop) {
+  try {
+    return new mxtpu::PrefetchLoader(path, batch_records, queue_cap,
+                                     loop != 0);
+  } catch (const std::exception& e) { set_err(e); return nullptr; }
+}
+
+void MXTPrefetchLoaderFree(void* h) {
+  delete static_cast<mxtpu::PrefetchLoader*>(h);
+}
+
+// returns: 0 ok (fills bytes/offsets pointers + counts), 1 end
+int MXTPrefetchLoaderNext(void* h, void** batch_handle,
+                          const char** bytes, int64_t* n_bytes,
+                          const int64_t** offsets, int64_t* n_records) {
+  auto* b = static_cast<mxtpu::PrefetchLoader*>(h)->Next();
+  if (b == nullptr) return 1;
+  *batch_handle = b;
+  *bytes = b->bytes.data();
+  *n_bytes = (int64_t)b->bytes.size();
+  *offsets = b->offsets.data();
+  *n_records = (int64_t)b->offsets.size() - 1;
+  return 0;
+}
+
+void MXTPrefetchBatchFree(void* batch_handle) {
+  delete static_cast<mxtpu::PrefetchLoader::Batch*>(batch_handle);
+}
+
+}  // extern "C"
