@@ -121,8 +121,13 @@ class NativeEngine:
         self._h = lib.MXTEngineCreate(num_workers)
         if not self._h:
             raise NativeError(lib.MXTGetLastError().decode())
-        # keep callback objects alive until executed
+        # Callback (CFUNCTYPE) objects must outlive the native call that
+        # returns through them: freeing one from inside its own
+        # trampoline is a use-after-free. Completed ids go to a
+        # graveyard that is only drained at wait_for_all()/close(),
+        # after the native side has fully quiesced.
         self._cbs = {}
+        self._dead = []
         self._cb_lock = threading.Lock()
         self._cb_id = 0
 
@@ -139,7 +144,7 @@ class NativeEngine:
                 fn()
             finally:
                 with self._cb_lock:
-                    self._cbs.pop(_id, None)
+                    self._dead.append(_id)
 
         cb = _CB_TYPE(trampoline)
         with self._cb_lock:
@@ -150,7 +155,15 @@ class NativeEngine:
             self._h, ctypes.cast(cb, ctypes.c_void_p), None,
             cv, len(const_vars), mv, len(mutable_vars))
         if ret != 0:
+            with self._cb_lock:
+                self._cbs.pop(cb_id, None)
             raise NativeError(self._lib.MXTGetLastError().decode())
+
+    def _drain_dead(self):
+        with self._cb_lock:
+            for cb_id in self._dead:
+                self._cbs.pop(cb_id, None)
+            self._dead.clear()
 
     def wait_for_var(self, var):
         if self._lib.MXTEngineWaitForVar(self._h, var) != 0:
@@ -159,11 +172,14 @@ class NativeEngine:
     def wait_for_all(self):
         if self._lib.MXTEngineWaitForAll(self._h) != 0:
             raise NativeError(self._lib.MXTGetLastError().decode())
+        self._drain_dead()
 
     def close(self):
         if self._h:
-            self._lib.MXTEngineFree(self._h)
+            self._lib.MXTEngineFree(self._h)  # joins workers first
             self._h = None
+            self._drain_dead()
+            self._cbs.clear()
 
     def __del__(self):
         try:
@@ -279,6 +295,9 @@ class PrefetchLoader:
             ctypes.byref(nb), ctypes.byref(offs), ctypes.byref(nr))
         if ret == 1:
             return None
+        if ret < 0:
+            raise NativeError(
+                self._lib.MXTRecordIOGetLastError().decode())
         raw = ctypes.string_at(by, nb.value)
         offsets = [offs[i] for i in range(nr.value + 1)]
         self._lib.MXTPrefetchBatchFree(bh)

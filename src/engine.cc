@@ -12,10 +12,12 @@
 // sets; two ops without a conflict run concurrently; writes serialize
 // with reads per variable in push order.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <stdexcept>
 #include <deque>
 #include <functional>
 #include <memory>
@@ -76,8 +78,22 @@ class Engine {
     return next_var_++;
   }
 
-  void Push(std::function<void()> fn, const std::vector<int64_t>& cvars,
-            const std::vector<int64_t>& mvars) {
+  void Push(std::function<void()> fn, const std::vector<int64_t>& cvars_in,
+            const std::vector<int64_t>& mvars_in) {
+    // dedup within each set; overlapping const/mutable would deadlock on
+    // the op's own read claim (the reference CHECK-fails here too)
+    std::vector<int64_t> cvars = cvars_in, mvars = mvars_in;
+    std::sort(cvars.begin(), cvars.end());
+    cvars.erase(std::unique(cvars.begin(), cvars.end()), cvars.end());
+    std::sort(mvars.begin(), mvars.end());
+    mvars.erase(std::unique(mvars.begin(), mvars.end()), mvars.end());
+    for (int64_t m : mvars) {
+      if (std::binary_search(cvars.begin(), cvars.end(), m)) {
+        throw std::runtime_error(
+            "engine: variable appears in both const_vars and "
+            "mutable_vars");
+      }
+    }
     Opr* op = new Opr();
     op->fn = std::move(fn);
     {

@@ -98,6 +98,7 @@ class PrefetchLoader {
     cv_pop_.notify_all();
     cv_push_.notify_all();
     th_.join();
+    for (Batch* b : queue_) delete b;
   }
 
   struct Batch {
@@ -105,7 +106,8 @@ class PrefetchLoader {
     std::vector<int64_t> offsets;  // n+1 entries
   };
 
-  // returns nullptr at end of data (non-loop mode)
+  // returns nullptr at end of data (non-loop mode); check Error()
+  // afterwards — corruption mid-stream must not look like clean EOF
   Batch* Next() {
     std::unique_lock<std::mutex> lk(m_);
     cv_pop_.wait(lk, [this]() {
@@ -118,6 +120,11 @@ class PrefetchLoader {
     return b;
   }
 
+  std::string Error() {
+    std::lock_guard<std::mutex> lk(m_);
+    return error_;
+  }
+
  private:
   void Loop() {
     std::vector<char> rec;
@@ -128,15 +135,18 @@ class PrefetchLoader {
         bool ok;
         try {
           ok = reader_.Next(&rec);
-        } catch (...) {
-          ok = false;
-        }
-        if (!ok) {
-          if (loop_) {
+          if (!ok && loop_) {
             reader_.Reset();
-            if (!reader_.Next(&rec)) { ok = false; }
-            else { ok = true; }
+            ok = reader_.Next(&rec);
           }
+        } catch (const std::exception& e) {
+          // propagate corruption to the consumer instead of faking EOF
+          std::lock_guard<std::mutex> lk(m_);
+          error_ = e.what();
+          eof_ = true;
+          cv_pop_.notify_all();
+          delete b;
+          return;
         }
         if (!ok) break;
         b->bytes.insert(b->bytes.end(), rec.begin(), rec.end());
@@ -165,6 +175,7 @@ class PrefetchLoader {
   bool loop_;
   bool eof_;
   bool stop_;
+  std::string error_;
   std::deque<Batch*> queue_;
   std::mutex m_;
   std::condition_variable cv_pop_, cv_push_;
@@ -251,12 +262,21 @@ void MXTPrefetchLoaderFree(void* h) {
   delete static_cast<mxtpu::PrefetchLoader*>(h);
 }
 
-// returns: 0 ok (fills bytes/offsets pointers + counts), 1 end
+// returns: 0 ok (fills bytes/offsets pointers + counts), 1 end,
+// -1 error (MXTRecordIOGetLastError)
 int MXTPrefetchLoaderNext(void* h, void** batch_handle,
                           const char** bytes, int64_t* n_bytes,
                           const int64_t** offsets, int64_t* n_records) {
-  auto* b = static_cast<mxtpu::PrefetchLoader*>(h)->Next();
-  if (b == nullptr) return 1;
+  auto* loader = static_cast<mxtpu::PrefetchLoader*>(h);
+  auto* b = loader->Next();
+  if (b == nullptr) {
+    std::string err = loader->Error();
+    if (!err.empty()) {
+      g_rio_error = err;
+      return -1;
+    }
+    return 1;
+  }
   *batch_handle = b;
   *bytes = b->bytes.data();
   *n_bytes = (int64_t)b->bytes.size();

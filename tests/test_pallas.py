@@ -1,0 +1,58 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh; the same kernels
+compile natively on TPU)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.ops.pallas_kernels import (flash_attention,
+                                          pallas_layer_norm,
+                                          _attn_reference)
+import mxnet_tpu as mx
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    r = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 256, 64
+    q, k, v = (jnp.asarray(r.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal)
+    ref = _attn_reference(q, k, v, causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-4
+
+
+def test_flash_attention_grad():
+    r = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 128, 32
+    q, k, v = (jnp.asarray(r.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    g1 = jax.grad(lambda a, b, c: flash_attention(a, b, c, True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b, c: _attn_reference(a, b, c, True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.abs(a - b).max()) < 2e-3
+
+
+def test_pallas_layer_norm():
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(37, 100), jnp.float32)
+    g = jnp.asarray(r.randn(100), jnp.float32)
+    b = jnp.asarray(r.randn(100), jnp.float32)
+    out = pallas_layer_norm(x, g, b)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / jnp.sqrt(var + 1e-5) * g + b
+    assert float(jnp.abs(out - ref).max()) < 1e-4
+
+
+def test_flash_attention_nd_op():
+    r = np.random.RandomState(3)
+    q = mx.nd.array(r.randn(1, 2, 64, 16).astype("float32"))
+    k = mx.nd.array(r.randn(1, 2, 64, 16).astype("float32"))
+    v = mx.nd.array(r.randn(1, 2, 64, 16).astype("float32"))
+    out = mx.nd.contrib.flash_attention(q, k, v, causal=True,
+                                        block_q=64, block_k=64)
+    ref = _attn_reference(q._data, k._data, v._data, True)
+    assert float(jnp.abs(out._data - ref).max()) < 2e-4
