@@ -1,0 +1,81 @@
+"""ResNet on CIFAR-10 with Gluon (reference: example/gluon/image_classification.py).
+
+Real CIFAR-10 if the binary batches are under --data-dir, else synthetic.
+
+Usage: python train_cifar10.py [--model resnet20ish] [--epochs 2] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--data-dir",
+                   default=os.path.join("~", ".mxnet", "datasets",
+                                        "cifar10"))
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--hybridize", action="store_true", default=True)
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    try:
+        from mxnet_tpu.gluon.data.vision import CIFAR10
+        train = CIFAR10(root=args.data_dir, train=True)
+        x = train._data.asnumpy().transpose(0, 3, 1, 2) / 255.0
+        y = train._label
+        print("using real CIFAR-10")
+    except RuntimeError:
+        print("CIFAR-10 not found; synthetic data")
+        rng = np.random.RandomState(0)
+        x = rng.rand(1024, 3, 32, 32).astype("float32")
+        y = rng.randint(0, 10, 1024).astype("float32")
+
+    loader = DataLoader(ArrayDataset(x.astype("float32"),
+                                     y.astype("float32")),
+                        batch_size=args.batch_size, shuffle=True,
+                        last_batch="discard")
+    net = vision.get_model(args.model, classes=10)
+    net.initialize(mx.initializer.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(args.epochs):
+        total, correct, lsum, n = 0, 0, 0.0, 0
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            lsum += float(loss.mean().asscalar())
+            n += 1
+            pred = out.argmax(axis=1).asnumpy()
+            correct += (pred == yb.asnumpy()).sum()
+            total += xb.shape[0]
+        print("epoch %d loss %.4f acc %.3f"
+              % (epoch, lsum / n, correct / total))
+
+
+if __name__ == "__main__":
+    main()

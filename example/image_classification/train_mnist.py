@@ -1,0 +1,89 @@
+"""LeNet on MNIST via the Module API — the reference's canonical first
+example (reference: example/image-classification/train_mnist.py).
+
+Runs on real MNIST if the idx files are under --data-dir, otherwise on
+synthetic data (the reference's `--benchmark 1` random-data mode,
+example/image-classification/common/fit.py).
+
+Usage: python train_mnist.py [--epochs 3] [--batch-size 64] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def lenet(num_classes=10):
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50)
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = mx.sym.Flatten(p2)
+    fc1 = mx.sym.FullyConnected(f, num_hidden=500)
+    a3 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(a3, num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def get_data(args):
+    import mxnet_tpu as mx
+    try:
+        from mxnet_tpu.gluon.data.vision import MNIST
+        train = MNIST(root=args.data_dir, train=True)
+        val = MNIST(root=args.data_dir, train=False)
+        xt = train._data.asnumpy().transpose(0, 3, 1, 2) / 255.0
+        xv = val._data.asnumpy().transpose(0, 3, 1, 2) / 255.0
+        yt, yv = train._label, val._label
+        print("using real MNIST from", args.data_dir)
+    except RuntimeError:
+        print("MNIST files not found; using synthetic data "
+              "(--benchmark mode)")
+        rng = np.random.RandomState(0)
+        xt = rng.rand(2000, 1, 28, 28).astype("float32")
+        yt = rng.randint(0, 10, 2000).astype("float32")
+        xv, yv = xt[:500], yt[:500]
+    train_iter = mx.io.NDArrayIter(xt.astype("float32"), yt,
+                                   args.batch_size, shuffle=True)
+    val_iter = mx.io.NDArrayIter(xv.astype("float32"), yv,
+                                 args.batch_size)
+    return train_iter, val_iter
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--data-dir",
+                   default=os.path.join("~", ".mxnet", "datasets",
+                                        "mnist"))
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    train_iter, val_iter = get_data(args)
+    mod = mx.mod.Module(lenet(), label_names=["softmax_label"])
+    mod.fit(train_iter, eval_data=val_iter, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20))
+    print("final accuracy:", mod.score(val_iter, "acc"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Word-level language model with truncated BPTT
+(reference: example/rnn/word_lm/train.py — stateful LSTM carrying hidden
+state across batches and detaching, SURVEY.md §5.7).
+
+Uses a synthetic integer corpus with learnable structure (next token =
+f(current)) unless --text points at a tokenizable file.
+
+Usage: python word_lm.py [--epochs 3] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def batchify(tokens, batch_size):
+    n = len(tokens) // batch_size
+    return np.asarray(tokens[:n * batch_size]).reshape(
+        batch_size, n).T  # (T, N)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--bptt", type=int, default=20)
+    p.add_argument("--hidden", type=int, default=100)
+    p.add_argument("--embed", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=50)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--optimizer", default="adam")
+    p.add_argument("--text", default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn, rnn
+
+    if args.text and os.path.exists(args.text):
+        with open(args.text) as f:
+            words = f.read().split()
+        vocab = {w: i for i, w in enumerate(sorted(set(words)))}
+        tokens = [vocab[w] for w in words]
+        args.vocab = len(vocab)
+    else:
+        rng = np.random.RandomState(0)
+        # markov-ish synthetic corpus: next = (cur * 7 + noise) % vocab
+        tokens = [0]
+        for _ in range(20000):
+            nxt = (tokens[-1] * 7 + rng.randint(0, 3)) % args.vocab
+            tokens.append(nxt)
+
+    data = batchify(tokens, args.batch_size)  # (T, N)
+
+    class RNNModel(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(args.vocab, args.embed)
+                self.lstm = rnn.LSTM(args.hidden, num_layers=2,
+                                     input_size=args.embed)
+                self.decoder = nn.Dense(args.vocab,
+                                        in_units=args.hidden)
+
+        def forward(self, x, state):
+            emb = self.embed(x)              # (T, N, E)
+            out, state = self.lstm(emb, state)
+            dec = self.decoder(out.reshape((-1, args.hidden)))
+            return dec, state
+
+    model = RNNModel()
+    model.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), args.optimizer,
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    T = data.shape[0]
+    for epoch in range(args.epochs):
+        state = model.lstm.begin_state(batch_size=args.batch_size)
+        total, n = 0.0, 0
+        for i in range(0, T - args.bptt - 1, args.bptt):
+            x = mx.nd.array(data[i:i + args.bptt])
+            y = mx.nd.array(
+                data[i + 1:i + args.bptt + 1].reshape(-1))
+            # truncated BPTT: carry state, cut the graph
+            state = [s.detach() for s in state]
+            with autograd.record():
+                out, state = model(x, state)
+                loss = loss_fn(out, y)
+            loss.backward()
+            grads = [p.grad() for p in
+                     model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(
+                grads, 0.25 * args.bptt * args.batch_size)
+            trainer.step(args.bptt * args.batch_size)
+            total += float(loss.mean().asscalar())
+            n += 1
+        ppl = float(np.exp(total / n))
+        print("epoch %d loss %.3f ppl %.2f" % (epoch, total / n, ppl))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Minimal SSD-style detector on synthetic boxes
+(reference: example/ssd/ — MultiBoxPrior/Target/Detection pipeline,
+SURVEY.md N5d).
+
+A tiny conv backbone predicts class scores + box offsets per anchor;
+targets come from contrib.MultiBoxTarget; detection decodes + NMS via
+contrib.MultiBoxDetection. Synthetic scenes contain one bright square on
+a dark background.
+
+Usage: python train_ssd.py [--steps 60] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def make_scene(rng, size=32):
+    img = np.zeros((3, size, size), np.float32)
+    w = rng.randint(8, 16)
+    x0 = rng.randint(0, size - w)
+    y0 = rng.randint(0, size - w)
+    img[:, y0:y0 + w, x0:x0 + w] = 1.0
+    box = np.array([0, x0 / size, y0 / size, (x0 + w) / size,
+                    (y0 + w) / size], np.float32)
+    return img, box
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+
+    num_classes = 1  # square vs background
+    sizes = (0.3, 0.45)
+    n_anchor_per_pos = len(sizes)
+
+    class TinySSD(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.backbone = nn.Sequential()
+                self.backbone.add(
+                    nn.Conv2D(16, 3, padding=1, activation="relu"),
+                    nn.MaxPool2D(2),
+                    nn.Conv2D(32, 3, padding=1, activation="relu"),
+                    nn.MaxPool2D(2))  # 32 -> 8x8 feature map
+                self.cls_head = nn.Conv2D(
+                    n_anchor_per_pos * (num_classes + 1), 3, padding=1)
+                self.box_head = nn.Conv2D(n_anchor_per_pos * 4, 3,
+                                          padding=1)
+
+        def forward(self, x):
+            feat = self.backbone(x)
+            anchors = mx.nd.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                                  ratios=(1.0,))
+            B = x.shape[0]
+            cls = self.cls_head(feat)  # (B, A*(C+1), H, W)
+            cls = cls.transpose((0, 2, 3, 1)).reshape(
+                (B, -1, num_classes + 1))
+            cls = cls.transpose((0, 2, 1))  # (B, C+1, N)
+            box = self.box_head(feat).transpose((0, 2, 3, 1)) \
+                .reshape((B, -1))
+            return anchors, cls, box
+
+    net = TinySSD()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    box_loss = gluon.loss.HuberLoss()
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        imgs, boxes = zip(*[make_scene(rng)
+                            for _ in range(args.batch_size)])
+        x = mx.nd.array(np.stack(imgs))
+        label = mx.nd.array(np.stack(boxes)[:, None, :])  # (B,1,5)
+        with autograd.record():
+            anchors, cls, box = net(x)
+            bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, label,
+                                                      cls)
+            l = cls_loss(cls, ct) + box_loss(box * bm, bt * bm)
+        l.backward()
+        trainer.step(args.batch_size)
+        if step % 10 == 0:
+            print("step %d loss %.4f" % (step,
+                                         float(l.mean().asscalar())))
+
+    # detect on one scene
+    img, box = make_scene(rng)
+    anchors, cls, boxp = net(mx.nd.array(img[None]))
+    probs = mx.nd.softmax(cls, axis=1)
+    det = mx.nd.contrib.MultiBoxDetection(probs, boxp, anchors,
+                                          nms_threshold=0.45).asnumpy()
+    best = det[0][det[0, :, 1].argmax()]
+    print("GT box:", box[1:], "-> detected:", best[2:6],
+          "score %.2f" % best[1])
+
+
+if __name__ == "__main__":
+    main()

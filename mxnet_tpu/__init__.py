@@ -58,6 +58,8 @@ from . import visualization as viz
 from . import rtc
 from . import image
 from .model import FeedForward
+from . import contrib
+from . import rnn
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

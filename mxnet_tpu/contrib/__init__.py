@@ -1,0 +1,15 @@
+"""Contrib: experimental / auxiliary APIs
+(reference: python/mxnet/contrib/).
+
+- quantization: int8 QDQ model quantization (quantize_model)
+- onnx: ONNX import/export (gated on the `onnx` package)
+- text: vocabulary + token embeddings
+- tensorboard: metric logging callback (gated on a SummaryWriter)
+- io/autograd: compatibility shims
+"""
+from . import quantization
+from . import text
+from . import onnx
+from . import tensorboard
+
+from .quantization import quantize_model

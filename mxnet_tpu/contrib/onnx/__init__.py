@@ -1,0 +1,10 @@
+"""ONNX import/export (reference: python/mxnet/contrib/onnx/).
+
+Gated on the `onnx` package, which is not part of this image — the API
+surface (export_model / import_model) matches the reference and raises
+a clear ImportError when onnx is unavailable.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

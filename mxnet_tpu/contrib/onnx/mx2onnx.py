@@ -1,0 +1,165 @@
+"""Symbol -> ONNX export.
+
+Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...symbol import Symbol
+from ... import symbol as sym_mod
+
+__all__ = ["export_model"]
+
+# mxnet op name -> (onnx op type, param translator)
+_MX2ONNX = {
+    "FullyConnected": ("Gemm", lambda p: {"alpha": 1.0, "beta": 1.0,
+                                          "transB": 1}),
+    "Convolution": ("Conv", lambda p: {
+        "kernel_shape": list(p.get("kernel", ())),
+        "strides": list(p.get("stride") or
+                        [1] * len(p.get("kernel", ()))),
+        "pads": list(p.get("pad") or [0] * len(p.get("kernel", ()))) * 2,
+        "dilations": list(p.get("dilate") or
+                          [1] * len(p.get("kernel", ()))),
+        "group": int(p.get("num_group", 1))}),
+    "Activation": ("__act__", None),
+    "Pooling": ("__pool__", None),
+    "BatchNorm": ("BatchNormalization",
+                  lambda p: {"epsilon": float(p.get("eps", 1e-3)),
+                             "momentum": float(p.get("momentum", 0.9))}),
+    "Flatten": ("Flatten", lambda p: {"axis": 1}),
+    "softmax": ("Softmax", lambda p: {"axis": int(p.get("axis", -1))}),
+    "SoftmaxOutput": ("Softmax", lambda p: {"axis": 1}),
+    "elemwise_add": ("Add", lambda p: {}),
+    "broadcast_add": ("Add", lambda p: {}),
+    "elemwise_mul": ("Mul", lambda p: {}),
+    "broadcast_mul": ("Mul", lambda p: {}),
+    "Concat": ("Concat", lambda p: {"axis": int(p.get("dim", 1))}),
+    "Dropout": ("Dropout", lambda p: {"ratio": float(p.get("p", 0.5))}),
+    "Reshape": ("__reshape__", None),
+    "transpose": ("Transpose",
+                  lambda p: {"perm": list(p.get("axes", ()))}),
+}
+
+# ops whose trailing label input must be dropped on export (the ONNX
+# form is inference-only)
+_DROP_LABEL_INPUT = {"SoftmaxOutput", "LinearRegressionOutput",
+                     "LogisticRegressionOutput", "MAERegressionOutput"}
+
+_ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus"}
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Exports a symbol + params to an ONNX file
+    (reference: export_model.py:32). Requires the `onnx` package."""
+    try:
+        import onnx
+        from onnx import helper, TensorProto, numpy_helper
+    except ImportError as e:
+        raise ImportError(
+            "export_model requires the `onnx` package, which is not "
+            "installed in this environment.") from e
+
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        from ... import ndarray
+        loaded = ndarray.load(params)
+        params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+    if not isinstance(sym, Symbol):
+        raise MXNetError("sym must be a Symbol or path to symbol json")
+
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    # label inputs of *Output heads are dropped from the exported graph
+    label_names = set()
+    from ...graph import topo_order as _topo
+    for node in _topo(sym._entries):
+        if not node.is_variable and node.op.name in _DROP_LABEL_INPUT \
+                and len(node.inputs) > 1:
+            lab = node.inputs[-1][0]
+            if lab.is_variable:
+                label_names.add(lab.name)
+    inputs = [n for n in sym.list_inputs()
+              if n not in params and n not in label_names]
+    assert len(inputs) == len(input_shape), \
+        "need one input_shape per data input %s" % inputs
+
+    nodes = []
+    initializers = []
+    value_name = {}
+
+    def name_of(node, idx):
+        return "%s_out%d" % (node.name, idx) if idx else node.name
+
+    for pname, arr in params.items():
+        initializers.append(numpy_helper.from_array(
+            arr.asnumpy(), name=pname))
+
+    from ...graph import topo_order
+    order = topo_order(sym._entries)
+    for node in order:
+        if node.is_variable:
+            continue
+        op_name = node.op.name
+        if op_name not in _MX2ONNX:
+            raise MXNetError(
+                "ONNX export: unsupported op %s" % op_name)
+        onnx_type, translate = _MX2ONNX[op_name]
+        node_inputs = node.inputs
+        if op_name in _DROP_LABEL_INPUT and len(node_inputs) > 1:
+            node_inputs = node_inputs[:1]
+        in_names = [name_of(i, idx) for (i, idx) in node_inputs]
+        if onnx_type == "__reshape__":
+            # ONNX Reshape takes the target shape as an int64 input
+            shape_name = node.name + "_shape"
+            initializers.append(numpy_helper.from_array(
+                np.asarray(node.params.get("shape", ()),
+                           dtype=np.int64), name=shape_name))
+            nodes.append(helper.make_node(
+                "Reshape", in_names + [shape_name],
+                [name_of(node, 0)], name=node.name))
+            value_name[id(node)] = name_of(node, 0)
+            continue
+        if onnx_type == "__act__":
+            onnx_type = _ACT2ONNX.get(
+                node.params.get("act_type", "relu"), "Relu")
+            attrs = {}
+        elif onnx_type == "__pool__":
+            ptype = node.params.get("pool_type", "max")
+            if node.params.get("global_pool"):
+                onnx_type = "GlobalMaxPool" if ptype == "max" \
+                    else "GlobalAveragePool"
+                attrs = {}
+            else:
+                onnx_type = "MaxPool" if ptype == "max" \
+                    else "AveragePool"
+                k = list(node.params.get("kernel", ()))
+                attrs = {"kernel_shape": k,
+                         "strides": list(node.params.get("stride") or
+                                         [1] * len(k)),
+                         "pads": list(node.params.get("pad") or
+                                      [0] * len(k)) * 2}
+        else:
+            attrs = translate(node.params)
+        nodes.append(helper.make_node(
+            onnx_type, in_names, [name_of(node, 0)], name=node.name,
+            **attrs))
+        value_name[id(node)] = name_of(node, 0)
+
+    onnx_dtype = TensorProto.FLOAT
+    graph_inputs = [
+        helper.make_tensor_value_info(n, onnx_dtype, list(s))
+        for n, s in zip(inputs, input_shape)]
+    graph_outputs = [
+        helper.make_tensor_value_info(name_of(n, i), onnx_dtype, None)
+        for (n, i) in sym._entries]
+    graph = helper.make_graph(nodes, "mxnet_tpu_model", graph_inputs,
+                              graph_outputs, initializer=initializers)
+    model = helper.make_model(graph)
+    onnx.save(model, onnx_file_path)
+    return onnx_file_path

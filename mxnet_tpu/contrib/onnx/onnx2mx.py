@@ -1,0 +1,140 @@
+"""ONNX -> Symbol import.
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import symbol as sym_mod
+from ... import ndarray
+
+__all__ = ["import_model"]
+
+
+def _attr_dict(onnx_node):
+    from onnx import helper
+    return {a.name: helper.get_attribute_value(a)
+            for a in onnx_node.attribute}
+
+
+def import_model(model_file):
+    """Imports an ONNX model file into (sym, arg_params, aux_params)
+    (reference: import_model.py:21). Requires the `onnx` package."""
+    try:
+        import onnx
+        from onnx import numpy_helper
+    except ImportError as e:
+        raise ImportError(
+            "import_model requires the `onnx` package, which is not "
+            "installed in this environment.") from e
+
+    model = onnx.load(model_file)
+    graph = model.graph
+
+    arg_params = {}
+    for init in graph.initializer:
+        arg_params[init.name] = ndarray.array(
+            numpy_helper.to_array(init))
+
+    tensors = {}
+    for inp in graph.input:
+        tensors[inp.name] = sym_mod.var(inp.name)
+    # since ONNX IR 4 initializers need not appear in graph.input
+    for name in arg_params:
+        if name not in tensors:
+            tensors[name] = sym_mod.var(name)
+
+    def get(name):
+        if name not in tensors:
+            raise MXNetError("ONNX import: unknown tensor %r" % name)
+        return tensors[name]
+
+    for node in graph.node:
+        attrs = _attr_dict(node)
+        ins = [get(n) for n in node.input]
+        t = node.op_type
+        if t == "Gemm":
+            w = arg_params[node.input[1]]
+            trans_b = int(attrs.get("transB", 0))
+            if float(attrs.get("alpha", 1.0)) != 1.0 or \
+                    float(attrs.get("beta", 1.0)) != 1.0:
+                raise MXNetError(
+                    "ONNX import: Gemm with alpha/beta != 1 is not "
+                    "supported")
+            if not trans_b:
+                # FullyConnected expects (out, in); transpose the stored
+                # weight once at import time
+                arg_params[node.input[1]] = ndarray.array(
+                    w.asnumpy().T)
+                w = arg_params[node.input[1]]
+            out = sym_mod.FullyConnected(
+                ins[0], ins[1], *ins[2:3],
+                num_hidden=int(w.shape[0]),
+                no_bias=len(ins) < 3)
+        elif t == "Conv":
+            k = tuple(attrs["kernel_shape"])
+            pads = tuple(attrs.get("pads", (0,) * (2 * len(k))))
+            out = sym_mod.Convolution(
+                *ins, kernel=k,
+                num_filter=int(arg_params[node.input[1]].shape[0]),
+                stride=tuple(attrs.get("strides", (1,) * len(k))),
+                pad=pads[:len(k)],
+                dilate=tuple(attrs.get("dilations", (1,) * len(k))),
+                num_group=int(attrs.get("group", 1)),
+                no_bias=len(ins) < 3)
+        elif t in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid",
+                   "Tanh": "tanh", "Softplus": "softrelu"}[t]
+            out = sym_mod.Activation(ins[0], act_type=act)
+        elif t in ("MaxPool", "AveragePool"):
+            k = tuple(attrs["kernel_shape"])
+            pads = tuple(attrs.get("pads", (0,) * (2 * len(k))))
+            out = sym_mod.Pooling(
+                ins[0], kernel=k,
+                pool_type="max" if t == "MaxPool" else "avg",
+                stride=tuple(attrs.get("strides", (1,) * len(k))),
+                pad=pads[:len(k)])
+        elif t in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = sym_mod.Pooling(
+                ins[0], global_pool=True, kernel=(1, 1),
+                pool_type="max" if t == "GlobalMaxPool" else "avg")
+        elif t == "BatchNormalization":
+            out = sym_mod.BatchNorm(
+                *ins, eps=float(attrs.get("epsilon", 1e-5)),
+                momentum=float(attrs.get("momentum", 0.9)),
+                fix_gamma=False)
+        elif t == "Flatten":
+            out = sym_mod.Flatten(ins[0])
+        elif t == "Softmax":
+            out = sym_mod.softmax(ins[0],
+                                  axis=int(attrs.get("axis", -1)))
+        elif t == "Add":
+            out = ins[0] + ins[1]
+        elif t == "Mul":
+            out = ins[0] * ins[1]
+        elif t == "Concat":
+            out = sym_mod.Concat(*ins, dim=int(attrs.get("axis", 1)))
+        elif t == "Dropout":
+            out = sym_mod.Dropout(ins[0],
+                                  p=float(attrs.get("ratio", 0.5)))
+        elif t == "Reshape":
+            out = sym_mod.Reshape(ins[0],
+                                  shape=tuple(attrs.get("shape", ())))
+        elif t == "Transpose":
+            out = sym_mod.transpose(ins[0],
+                                    axes=tuple(attrs.get("perm", ())))
+        else:
+            raise MXNetError("ONNX import: unsupported op %s" % t)
+        outs = out if isinstance(out, list) else [out]
+        for name, o in zip(node.output, outs):
+            tensors[name] = o
+
+    result = [get(o.name) for o in graph.output]
+    sym = result[0] if len(result) == 1 else sym_mod.Group(result)
+    aux_names = set(sym.list_auxiliary_states())
+    aux_params = {k: v for k, v in arg_params.items() if k in aux_names}
+    arg_params = {k: v for k, v in arg_params.items()
+                  if k not in aux_names}
+    return sym, arg_params, aux_params

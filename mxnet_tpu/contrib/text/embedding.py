@@ -1,0 +1,210 @@
+"""Text token embeddings.
+
+Reference: python/mxnet/contrib/text/embedding.py (_TokenEmbedding,
+GloVe, FastText, CustomEmbedding) + vocab.py.
+
+No-egress note: the reference downloads pretrained files; here
+CustomEmbedding loads any local `token<space/tab>vec...` text file, and
+the named classes resolve only local files under their root.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ... import ndarray
+from ...ndarray import NDArray
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "GloVe", "FastText"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(embedding_name, **kwargs):
+    """Create a token embedding by name
+    (reference: embedding.py create)."""
+    return _REGISTRY[embedding_name.lower()](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """List locally available pretrained files
+    (reference: embedding.py:91)."""
+    out = {}
+    for name, klass in _REGISTRY.items():
+        root = os.path.expanduser(klass._root)
+        files = sorted(os.listdir(root)) if os.path.isdir(root) else []
+        out[name] = files
+    if embedding_name is not None:
+        return out.get(embedding_name.lower(), [])
+    return out
+
+
+class TokenEmbedding:
+    """Base embedding: token -> vector with OOV handling
+    (reference: embedding.py _TokenEmbedding)."""
+
+    _root = os.path.join("~", ".mxnet", "embeddings")
+
+    def __init__(self, init_unknown_vec=None, unknown_token="<unk>"):
+        self._init_unknown_vec = init_unknown_vec or (
+            lambda shape: np.zeros(shape, np.float32))
+        self.unknown_token = unknown_token
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_token = [unknown_token]
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading --------------------------------------------------------
+    def _load_embedding(self, path, elem_delim=" ", encoding="utf8"):
+        vectors = []
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if line_num == 0 and len(elems) == 1:
+                    continue  # fasttext-style header line
+                if token in self._token_to_idx:
+                    continue
+                try:
+                    vec = np.asarray(elems, dtype=np.float32)
+                except ValueError:
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vectors.append(vec)
+        unk = self._init_unknown_vec((self._vec_len,))
+        self._idx_to_vec = ndarray.array(
+            np.vstack([unk[None, :]] + vectors)
+            if vectors else unk[None, :])
+
+    # -- queries --------------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up vectors (reference: embedding.py:311)."""
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        indices = []
+        for t in tokens:
+            if t in self._token_to_idx:
+                indices.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                indices.append(self._token_to_idx[t.lower()])
+            else:
+                indices.append(0)
+        vecs = ndarray.array(
+            self._idx_to_vec.asnumpy()[np.asarray(indices)])
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known tokens
+        (reference: embedding.py:352)."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        arr = self._idx_to_vec.asnumpy()
+        nv = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else np.asarray(new_vectors)
+        nv = nv.reshape(len(tokens), -1)
+        for t, v in zip(tokens, nv):
+            if t not in self._token_to_idx:
+                raise KeyError("token %r is unknown" % t)
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = ndarray.array(arr)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user-provided text file
+    (reference: embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim, encoding)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe embeddings from a local file (reference: embedding.py
+    GloVe; files must already be under ~/.mxnet/embeddings/glove)."""
+
+    _root = os.path.join("~", ".mxnet", "embeddings", "glove")
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt", **kwargs):
+        super().__init__(**kwargs)
+        path = os.path.join(os.path.expanduser(self._root),
+                            pretrained_file_name)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "%s not found; this environment has no egress — place "
+                "the GloVe file there manually." % path)
+        self._load_embedding(path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText embeddings from a local file
+    (reference: embedding.py FastText)."""
+
+    _root = os.path.join("~", ".mxnet", "embeddings", "fasttext")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec", **kwargs):
+        super().__init__(**kwargs)
+        path = os.path.join(os.path.expanduser(self._root),
+                            pretrained_file_name)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "%s not found; this environment has no egress — place "
+                "the fastText file there manually." % path)
+        self._load_embedding(path)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenation of several embeddings over one vocabulary
+    (reference: embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        mats = []
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token)
+            mats.append(vecs.asnumpy())
+        full = np.concatenate(mats, axis=1)
+        self._vec_len = full.shape[1]
+        self._idx_to_vec = ndarray.array(full)

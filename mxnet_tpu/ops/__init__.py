@@ -7,4 +7,5 @@ from . import random_ops  # noqa: F401
 from . import init_ops    # noqa: F401
 from . import contrib     # noqa: F401
 from . import pallas_kernels  # noqa: F401
+from . import quantization as quantization_ops  # noqa: F401
 from .registry import get, exists, list_ops, register, Op  # noqa: F401

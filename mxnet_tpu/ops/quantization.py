@@ -1,0 +1,109 @@
+"""Quantization (int8) operators.
+
+Reference: src/operator/quantization/ (quantize.cc, dequantize.cc,
+requantize.cc, quantized conv/FC; SURVEY.md N5h).
+
+TPU-native design: inference quantization is expressed as
+quantize→int8-compute→dequantize where the int8 matmul/conv feeds the
+MXU's int8 path (XLA lowers int8 dot_general natively); the
+quantize-dequantize (QDQ) pair around other ops simulates the precision
+while letting XLA fuse. Ranges use the reference's signed int8
+convention (symmetric, [-127, 127]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_INT8_RANGE = 127.0
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _quantize(data, min_range, max_range, *, out_type="int8"):
+    """Quantize fp32 -> int8 given calibrated range
+    (reference: quantization/quantize.cc)."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = _INT8_RANGE / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize")
+def _dequantize(data, min_range, max_range, *, out_type="float32"):
+    """Dequantize int8 -> fp32 (reference: quantization/dequantize.cc)."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = amax / _INT8_RANGE
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _requantize(data, min_range, max_range, *, min_calib_range=None,
+                max_calib_range=None):
+    """Requantize int32 accumulators -> int8
+    (reference: quantization/requantize.cc)."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        / (2.0 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = jnp.float32(max(abs(min_calib_range),
+                               abs(max_calib_range)))
+    else:
+        amax = jnp.max(jnp.abs(real))
+    scale = _INT8_RANGE / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def _quantized_fc(data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+                  *, num_hidden, no_bias=False, flatten=True):
+    """int8 x int8 -> int32 fully connected
+    (reference: quantized_fully_connected.cc). The int8 dot rides the
+    MXU's native int8 path."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = lax.dot_general(x.astype(jnp.int32), weight.astype(jnp.int32),
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax))
+    w_amax = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax))
+    out_scale = (d_amax / _INT8_RANGE) * (w_amax / _INT8_RANGE)
+    if not no_bias:
+        # bias arrives int8 with its own scale; fold into the int32
+        # accumulator domain
+        b_amax = jnp.maximum(jnp.abs(bmin), jnp.abs(bmax))
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_RANGE)
+        acc = acc + jnp.round(b_real / jnp.maximum(out_scale, 1e-20)
+                              ).astype(jnp.int32)
+    amax_out = out_scale * (2.0 ** 31 - 1)
+    return acc, -amax_out, amax_out
+
+
+def fake_quant(x, amax):
+    """QDQ fake-quantization used by the graph pass for ops without a
+    dedicated int8 kernel."""
+    scale = _INT8_RANGE / jnp.maximum(amax, 1e-12)
+    return jnp.round(jnp.clip(x * scale, -127, 127)) / scale
+
+
+@register("_contrib_qdq")
+def _qdq(data, *, amax=0.0, signed=True):
+    """Fake-quantize (quantize-dequantize) with a calibrated range;
+    amax==0 means use the tensor's own max (weights at bind time).
+    signed=False is the uint8 asymmetric-positive path (post-ReLU
+    activations). The straight-through estimator keeps it trainable
+    (QAT)."""
+    x = data.astype(jnp.float32)
+    a = jnp.where(jnp.float32(amax) > 0, jnp.float32(amax),
+                  jnp.max(jnp.abs(x)) + 1e-12)
+    if signed:
+        q = fake_quant(x, a)
+    else:
+        scale = 255.0 / a
+        q = jnp.round(jnp.clip(x * scale, 0, 255)) / scale
+    # straight-through gradient
+    return data + lax.stop_gradient(q - x).astype(data.dtype)

@@ -1,0 +1,14 @@
+"""Legacy rnn namespace (reference: python/mxnet/rnn/).
+
+The reference keeps a pre-Gluon cell API here plus BucketSentenceIter.
+The cell classes are provided as aliases of the gluon cells (same math,
+unroll() contract); BucketSentenceIter is native.
+"""
+from .io import BucketSentenceIter
+from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                         BidirectionalCell, DropoutCell, ZoneoutCell,
+                         ResidualCell)
+
+__all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell"]

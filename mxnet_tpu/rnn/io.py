@@ -1,0 +1,110 @@
+"""Bucketed sequence iterator.
+
+Reference: python/mxnet/rnn/io.py (BucketSentenceIter) — the long-
+sequence strategy of the reference era (SURVEY.md §5.7): group sentences
+into a small set of padded length buckets; BucketingModule compiles one
+executor per bucket. On TPU the same bucketing bounds the number of XLA
+recompiles (one per bucket shape).
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray import array
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over tokenized sentences
+    (reference: rnn/io.py:35)."""
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            lengths = [len(s) for s in sentences]
+            cnt = np.bincount(lengths)
+            buckets = [i for i, n in enumerate(cnt)
+                       if n >= max(1, batch_size // 4)]
+            if not buckets:
+                buckets = [max(lengths)]
+        buckets.sort()
+        self.data = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            buck = np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        # empty buckets would reshape to 1-D; keep (0, bucket_len) shape
+        self.data = [np.asarray(x, dtype=dtype) if x
+                     else np.empty((0, b), dtype=dtype)
+                     for x, b in zip(self.data, buckets)]
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.nddata = []
+        self.ndlabel = []
+        self.major_axis = layout.find("N")
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        shape = (batch_size, self.default_bucket_key) \
+            if self.major_axis == 0 else (self.default_bucket_key,
+                                          batch_size)
+        self.provide_data = [DataDesc(data_name, shape, dtype,
+                                      layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, dtype,
+                                       layout=layout)]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in
+                             range(0, len(buck) - batch_size + 1,
+                                   batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        random.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(array(buck, dtype=self.dtype))
+            self.ndlabel.append(array(label, dtype=self.dtype))
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        if self.major_axis == 1:
+            data = self.nddata[i][j:j + self.batch_size].T
+            label = self.ndlabel[i][j:j + self.batch_size].T
+        else:
+            data = self.nddata[i][j:j + self.batch_size]
+            label = self.ndlabel[i][j:j + self.batch_size]
+        return DataBatch([data], [label], pad=0,
+                         bucket_key=self.buckets[i],
+                         provide_data=[DataDesc(
+                             self.data_name, data.shape, self.dtype,
+                             layout=self.layout)],
+                         provide_label=[DataDesc(
+                             self.label_name, label.shape, self.dtype,
+                             layout=self.layout)])

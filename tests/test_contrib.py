@@ -1,0 +1,151 @@
+"""Contrib tests: quantization, contrib ops (NMS/multibox/CTC), text."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantize_model
+from mxnet_tpu.contrib.text import Vocabulary
+from mxnet_tpu.contrib.text.embedding import CustomEmbedding
+from mxnet_tpu.contrib.text.utils import count_tokens_from_str
+
+
+def test_quantize_model_close_to_fp32():
+    np.random.seed(0)
+    X = np.random.randn(64, 8).astype("float32")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Activation(mx.sym.FullyConnected(
+            mx.sym.var("data"), num_hidden=16, name="fc1"),
+            act_type="relu"),
+        num_hidden=4, name="fc2"), name="softmax")
+    args = {"fc1_weight": mx.nd.random.normal(shape=(16, 8)),
+            "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.random.normal(shape=(4, 16)),
+            "fc2_bias": mx.nd.zeros((4,))}
+    it = mx.io.NDArrayIter(X, np.zeros(64, "float32"), batch_size=16)
+    qsym, qargs, _ = quantize_model(net, args, {}, calib_data=it,
+                                    num_calib_examples=32)
+    common = {"data": mx.nd.array(X[:16]),
+              "softmax_label": mx.nd.zeros((16,))}
+    out_fp = net.bind(mx.cpu(), args={**args, **common},
+                      grad_req="null").forward()[0].asnumpy()
+    out_q = qsym.bind(mx.cpu(), args={**qargs, **common},
+                      grad_req="null").forward()[0].asnumpy()
+    assert np.abs(out_fp - out_q).max() < 0.05
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(np.linspace(-3, 3, 32, dtype="float32"))
+    q, mn, mx_ = mx.nd.contrib.quantize(x, mx.nd.array([-3.0]),
+                                        mx.nd.array([3.0]))
+    assert q.dtype == np.int8
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    assert np.abs(back.asnumpy() - x.asnumpy()).max() < 3.0 / 127 + 1e-6
+
+
+def test_box_nms():
+    # three boxes: two overlapping (keep higher score), one separate
+    boxes = mx.nd.array([[[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                          [0, 0.8, 0.05, 0.05, 1.0, 1.0],
+                          [1, 0.7, 2.0, 2.0, 3.0, 3.0]]])
+    out = mx.nd.contrib.box_nms(boxes, overlap_thresh=0.5,
+                                id_index=0).asnumpy()[0]
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 2
+    assert np.isclose(kept[0, 1], 0.9)
+    assert np.isclose(kept[1, 1], 0.7)
+
+
+def test_box_iou():
+    a = mx.nd.array([[0.0, 0.0, 1.0, 1.0]])
+    b = mx.nd.array([[0.5, 0.5, 1.5, 1.5], [2.0, 2.0, 3.0, 3.0]])
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    assert np.isclose(iou[0, 0], 0.25 / 1.75, atol=1e-5)
+    assert iou[0, 1] == 0
+
+
+def test_multibox_prior_shapes():
+    x = mx.nd.zeros((1, 8, 4, 4))
+    anchors = mx.nd.contrib.MultiBoxPrior(
+        x, sizes=(0.5, 0.25), ratios=(1, 2)).asnumpy()
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    # centers in (0,1)
+    cx = (anchors[0, :, 0] + anchors[0, :, 2]) / 2
+    assert (cx > 0).all() and (cx < 1).all()
+
+
+def test_multibox_target_detection_roundtrip():
+    anchors = mx.nd.contrib.MultiBoxPrior(mx.nd.zeros((1, 4, 2, 2)),
+                                          sizes=(0.5,), ratios=(1,))
+    # one GT box near the first anchor
+    label = mx.nd.array([[[0, 0.0, 0.0, 0.55, 0.55]]])
+    cls_pred = mx.nd.zeros((1, 2, anchors.shape[1]))
+    bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    ct = ct.asnumpy()
+    assert (ct == 1).sum() >= 1  # at least the forced match
+    # decode a perfect prediction back to the GT box
+    loc_pred = bt  # predicting exactly the target must recover the box
+    probs = mx.nd.array(np.stack(
+        [np.where(ct == 1, 0.1, 0.9), np.where(ct == 1, 0.9, 0.1)],
+        axis=1))
+    det = mx.nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
+                                          nms_threshold=0.5).asnumpy()
+    best = det[0][det[0, :, 1].argmax()]
+    assert best[0] == 0  # class id
+    np.testing.assert_allclose(best[2:6], [0.0, 0.0, 0.55, 0.55],
+                               atol=0.05)
+
+
+def test_ctc_loss_matches_bruteforce():
+    """2-frame, 3-class brute force check."""
+    T, N, C = 2, 1, 3
+    logits = np.log(np.array(
+        [[[0.6, 0.3, 0.1]], [[0.2, 0.5, 0.3]]], dtype="float32"))
+    label = np.array([[1]], dtype="float32")  # single symbol '1'
+    loss = mx.nd.contrib.CTCLoss(mx.nd.array(logits),
+                                 mx.nd.array(label)).asnumpy()[0]
+    # paths for label [1] with blank=0 over 2 frames:
+    # (1,1), (0,1), (1,0)
+    p = 0.3 * 0.5 + 0.6 * 0.5 + 0.3 * 0.2
+    assert np.isclose(loss, -np.log(p), atol=1e-4)
+
+
+def test_vocabulary_and_embedding(tmp_path):
+    counter = count_tokens_from_str("a b b c c c")
+    v = Vocabulary(counter, min_freq=2)
+    assert v.to_indices("c") == 1  # most frequent first
+    assert v.to_indices("a") == 0  # below min_freq -> unknown
+    p = tmp_path / "emb.txt"
+    p.write_text("b 1.0 0.0\nc 0.0 1.0\n")
+    emb = CustomEmbedding(str(p))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["b", "c", "zzz"]).asnumpy(),
+        [[1, 0], [0, 1], [0, 0]])
+
+
+def test_roi_align_and_resize():
+    x = mx.nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = mx.nd.array([[0, 0, 0, 3, 3]])
+    out = mx.nd.contrib.ROIAlign(x, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    r = mx.nd.contrib.BilinearResize2D(x, height=8, width=8)
+    assert r.shape == (1, 1, 8, 8)
+    a = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    np.testing.assert_allclose(
+        a.asnumpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_fft_roundtrip():
+    x = mx.nd.random.uniform(shape=(2, 8))
+    f = mx.nd.contrib.fft(x)
+    assert f.shape == (2, 16)
+    back = mx.nd.contrib.ifft(f)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=1e-5)
+
+
+def test_quadratic():
+    x = mx.nd.array([1.0, 2.0])
+    out = mx.nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0)
+    np.testing.assert_allclose(out.asnumpy(), [6.0, 11.0])
