@@ -52,3 +52,10 @@ def _refresh_namespaces():
 
 
 _refresh_namespaces()
+
+# higher-order control-flow frontends (reference: ndarray/contrib.py
+# foreach :101, while_loop :195, cond :366)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402
+contrib.foreach = foreach
+contrib.while_loop = while_loop
+contrib.cond = cond

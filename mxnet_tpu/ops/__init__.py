@@ -8,4 +8,5 @@ from . import init_ops    # noqa: F401
 from . import contrib     # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import quantization as quantization_ops  # noqa: F401
+from . import control_flow  # noqa: F401
 from .registry import get, exists, list_ops, register, Op  # noqa: F401

@@ -1,0 +1,515 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (6 registrations) +
+python/mxnet/{ndarray,symbol}/contrib.py:101-660. The reference executes
+these by looping a CachedOp over an NNVM subgraph; the TPU-native design
+lowers them onto XLA's structured control flow instead:
+
+- foreach     -> lax.scan over axis 0                 (differentiable)
+- while_loop  -> bounded lax.scan with an active mask (differentiable;
+                 the reference likewise pads outputs to max_iterations)
+- cond        -> lax.cond
+
+Two frontends share the lowering:
+
+* Symbol path: ``mx.sym.contrib.foreach(body, data, states)`` traces
+  ``body`` with fresh variable Symbols into a subgraph, then emits ONE
+  graph node (op `_foreach` etc.) whose inputs are data+states+closure
+  vars; op.fn replays the subgraph under lax.scan. jax.grad through the
+  enclosing jitted program differentiates it (reference: subgraph grad
+  via CachedOp::Backward).
+* NDArray path: ``mx.nd.contrib.foreach`` traces the body once under
+  lax.scan (so eager foreach is still a single XLA program, not T
+  dispatches); under autograd.record() the whole scan is recorded as one
+  tape node via jax.vjp. while_loop/cond run the genuinely
+  data-dependent Python path on concrete values, matching the
+  reference's imperative semantics exactly.
+
+Known limits (documented, tested): gradients don't flow into NDArrays
+captured by closure in the *eager* foreach body (they do on the Symbol
+path, where closures become explicit node inputs); BatchNorm-style aux
+updates inside a control-flow body are not written back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, get as _get_op
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+_uid = [0]
+
+
+def _fresh(prefix):
+    _uid[0] += 1
+    return "_cf%d_%s" % (_uid[0], prefix)
+
+
+def _as_list(x):
+    if x is None:
+        raise MXNetError("control flow: data/states must be an NDArray/"
+                         "Symbol or a list of them, got None")
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+# ---------------------------------------------------------------------------
+# subgraph helpers (Symbol path)
+# ---------------------------------------------------------------------------
+
+def _subgraph_fn(entries, mode):
+    """Build args/aux-merged evaluator for a subgraph: f(values: dict, key)
+    -> list of output arrays. values maps every leaf var name -> array."""
+    from ..graph import build_graph_fn
+    fn, arg_names, aux_names, _needs_rng = build_graph_fn(entries, mode)
+
+    def run(values, key):
+        args = {n: values[n] for n in arg_names}
+        aux = {n: values[n] for n in aux_names}
+        outs, _aux_up = fn(args, aux, key)
+        return outs
+
+    return run
+
+
+def _split_inputs(arrays, counts):
+    out = []
+    i = 0
+    for c in counts:
+        out.append(arrays[i:i + c])
+        i += c
+    return out
+
+
+# ---------------------------------------------------------------------------
+# core ops (shared by Symbol graph lowering; jax-traceable)
+# ---------------------------------------------------------------------------
+
+@register("_foreach", needs_rng=True, takes_mode=True,
+          num_outputs=lambda p: p["n_outputs"] + p["n_states"])
+def _foreach_op(key, *arrays, subgraph=None, n_data=0, n_states=0,
+                n_outputs=0, data_names=(), state_names=(),
+                closure_names=(), _mode="predict"):
+    """Scan the body subgraph over axis 0 of the data inputs."""
+    run = _subgraph_fn(subgraph, _mode)
+    data, states, closure = _split_inputs(
+        arrays, (n_data, n_states, len(closure_names)))
+    closure_vals = dict(zip(closure_names, closure))
+
+    def step(carry, xs):
+        k, st = carry
+        k, sub = jax.random.split(k)
+        values = {**closure_vals,
+                  **dict(zip(data_names, xs)),
+                  **dict(zip(state_names, st))}
+        outs = run(values, sub)
+        new_states = tuple(outs[n_outputs:])
+        return (k, new_states), tuple(outs[:n_outputs])
+
+    (_, final_states), stacked = lax.scan(
+        step, (key, tuple(states)), tuple(data))
+    return tuple(stacked) + tuple(final_states)
+
+
+@register("_while_loop", needs_rng=True, takes_mode=True,
+          num_outputs=lambda p: p["n_outputs"] + p["n_states"])
+def _while_loop_op(key, *arrays, cond_graph=None, body_graph=None,
+                   max_iterations=None, n_states=0, n_outputs=0,
+                   state_names=(), cond_closure_names=(),
+                   body_closure_names=(), _mode="predict"):
+    """Bounded masked scan: differentiable while-loop à la the reference
+    (outputs padded to max_iterations; inactive rows are zeros)."""
+    cond_run = _subgraph_fn(cond_graph, _mode)
+    body_run = _subgraph_fn(body_graph, _mode)
+    states, cond_clo, body_clo = _split_inputs(
+        arrays, (n_states, len(cond_closure_names),
+                 len(body_closure_names)))
+    cond_vals = dict(zip(cond_closure_names, cond_clo))
+    body_vals = dict(zip(body_closure_names, body_clo))
+
+    def one_body(st, k):
+        values = {**body_vals, **dict(zip(state_names, st))}
+        return body_run(values, k)
+
+    def step(carry, _):
+        k, st, active = carry
+        k, sub = jax.random.split(k)
+        pred = cond_run({**cond_vals, **dict(zip(state_names, st))},
+                        sub)[0]
+        pred = jnp.reshape(pred, ()).astype(bool)
+        active = jnp.logical_and(active, pred)
+        outs = one_body(st, sub)
+        new_states = tuple(
+            jnp.where(active, n, s)
+            for n, s in zip(outs[n_outputs:], st))
+        emitted = tuple(
+            jnp.where(active, o, jnp.zeros(o.shape, o.dtype))
+            for o in outs[:n_outputs])
+        return (k, new_states, active), emitted
+
+    (_, final_states, _), stacked = lax.scan(
+        step, (key, tuple(states), jnp.bool_(True)), None,
+        length=int(max_iterations))
+    return tuple(stacked) + tuple(final_states)
+
+
+@register("_cond", needs_rng=True, takes_mode=True,
+          num_outputs=lambda p: p["n_outputs"])
+def _cond_op(key, pred, *arrays, then_graph=None, else_graph=None,
+             n_outputs=0, then_closure_names=(), else_closure_names=(),
+             _mode="predict"):
+    then_run = _subgraph_fn(then_graph, _mode)
+    else_run = _subgraph_fn(else_graph, _mode)
+    then_clo, else_clo = _split_inputs(
+        arrays, (len(then_closure_names), len(else_closure_names)))
+    then_vals = dict(zip(then_closure_names, then_clo))
+    else_vals = dict(zip(else_closure_names, else_clo))
+    k1, k2 = jax.random.split(key)
+
+    def then_branch(_):
+        return tuple(then_run(then_vals, k1))
+
+    def else_branch(_):
+        return tuple(else_run(else_vals, k2))
+
+    p = jnp.reshape(pred, ()).astype(bool)
+    out = lax.cond(p, then_branch, else_branch, operand=None)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Symbol frontends
+# ---------------------------------------------------------------------------
+
+def _sym_entries(syms):
+    entries = []
+    for s in syms:
+        entries.extend(s._entries)
+    return entries
+
+
+def _single_entry(sym, what):
+    """Graph-node input entry of a one-output Symbol; multi-output
+    symbols would silently shift the op's positional input binding."""
+    if len(sym._entries) != 1:
+        raise MXNetError(
+            "control flow: %s must be a single-output Symbol, got one "
+            "with %d outputs (index it first, e.g. sym[0])"
+            % (what, len(sym._entries)))
+    return sym._entries[0]
+
+
+def _closure_vars(entries, exclude_names):
+    """Leaf variables of a subgraph that aren't the fresh loop vars."""
+    from ..graph import collect_vars
+    args, aux = collect_vars(entries)
+    out = []
+    for n in args + aux:
+        if n.name not in exclude_names:
+            out.append(n)
+    return out
+
+
+def _foreach_sym(body, data, init_states):
+    from ..graph import Node
+    from . import registry as _reg
+    from ..symbol import Symbol, var as sym_var
+
+    data_list, data_single = _as_list(data)
+    state_list, state_single = _as_list(init_states)
+    uid = _fresh("foreach")
+    data_vars = [sym_var("%s_data%d" % (uid, i))
+                 for i in range(len(data_list))]
+    state_vars = [sym_var("%s_state%d" % (uid, i))
+                  for i in range(len(state_list))]
+
+    outs, new_states = body(data_vars[0] if data_single else data_vars,
+                            state_vars[0] if state_single else state_vars)
+    out_list, out_single = _as_list(outs)
+    new_state_list, _ = _as_list(new_states)
+    if len(new_state_list) != len(state_list):
+        raise MXNetError(
+            "foreach: body returned %d states, expected %d"
+            % (len(new_state_list), len(state_list)))
+
+    entries = _sym_entries(out_list) + _sym_entries(new_state_list)
+    fresh = {v.name for v in data_vars + state_vars}
+    closure = _closure_vars(entries, fresh)
+
+    node = Node(
+        _get_op("_foreach"),
+        [_single_entry(s, "data") for s in data_list]
+        + [_single_entry(s, "init_states") for s in state_list]
+        + [(c, 0) for c in closure],
+        {"subgraph": tuple(entries),
+         "n_data": len(data_list), "n_states": len(state_list),
+         "n_outputs": len(out_list),
+         "data_names": tuple(v.name for v in data_vars),
+         "state_names": tuple(v.name for v in state_vars),
+         "closure_names": tuple(c.name for c in closure)},
+        _fresh("foreach_node"))
+    outputs = Symbol([(node, i) for i in range(len(out_list))])
+    states = Symbol([(node, len(out_list) + i)
+                     for i in range(len(state_list))])
+    out_ret = outputs[0] if out_single and len(out_list) == 1 else outputs
+    st_ret = ([states[i] for i in range(len(state_list))]
+              if not state_single else states)
+    return out_ret, st_ret
+
+
+def _while_loop_sym(cond_fn, func, loop_vars, max_iterations):
+    from ..graph import Node
+    from ..symbol import Symbol, var as sym_var
+
+    if max_iterations is None:
+        raise MXNetError("while_loop: max_iterations is required")
+    state_list, state_single = _as_list(loop_vars)
+    uid = _fresh("while")
+    state_vars = [sym_var("%s_var%d" % (uid, i))
+                  for i in range(len(state_list))]
+
+    pred_sym = cond_fn(*state_vars)
+    step_out, new_states = func(*state_vars)
+    out_list, _ = _as_list(step_out)
+    new_state_list, _ = _as_list(new_states)
+    if len(new_state_list) != len(state_list):
+        raise MXNetError(
+            "while_loop: func returned %d loop_vars, expected %d"
+            % (len(new_state_list), len(state_list)))
+
+    fresh = {v.name for v in state_vars}
+    cond_entries = tuple(pred_sym._entries)
+    body_entries = tuple(_sym_entries(out_list)
+                         + _sym_entries(new_state_list))
+    cond_closure = _closure_vars(cond_entries, fresh)
+    body_closure = _closure_vars(body_entries, fresh)
+
+    node = Node(
+        _get_op("_while_loop"),
+        [_single_entry(s, "loop_vars") for s in state_list]
+        + [(c, 0) for c in cond_closure]
+        + [(c, 0) for c in body_closure],
+        {"cond_graph": cond_entries, "body_graph": body_entries,
+         "max_iterations": int(max_iterations),
+         "n_states": len(state_list), "n_outputs": len(out_list),
+         "state_names": tuple(v.name for v in state_vars),
+         "cond_closure_names": tuple(c.name for c in cond_closure),
+         "body_closure_names": tuple(c.name for c in body_closure)},
+        _fresh("while_node"))
+    outputs = [Symbol([(node, i)]) for i in range(len(out_list))]
+    states = [Symbol([(node, len(out_list) + i)])
+              for i in range(len(state_list))]
+    return outputs, (states[0] if state_single and len(states) == 1
+                     else states)
+
+
+def _cond_sym(pred, then_func, else_func):
+    from ..graph import Node
+    from ..symbol import Symbol
+
+    then_out, then_single = _as_list(then_func())
+    else_out, _ = _as_list(else_func())
+    if len(then_out) != len(else_out):
+        raise MXNetError(
+            "cond: then_func returned %d outputs, else_func %d"
+            % (len(then_out), len(else_out)))
+
+    then_entries = tuple(_sym_entries(then_out))
+    else_entries = tuple(_sym_entries(else_out))
+    then_closure = _closure_vars(then_entries, set())
+    else_closure = _closure_vars(else_entries, set())
+
+    node = Node(
+        _get_op("_cond"),
+        [_single_entry(pred, "pred")]
+        + [(c, 0) for c in then_closure]
+        + [(c, 0) for c in else_closure],
+        {"then_graph": then_entries, "else_graph": else_entries,
+         "n_outputs": len(then_out),
+         "then_closure_names": tuple(c.name for c in then_closure),
+         "else_closure_names": tuple(c.name for c in else_closure)},
+        _fresh("cond_node"))
+    outs = [Symbol([(node, i)]) for i in range(len(then_out))]
+    return outs[0] if then_single and len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# NDArray frontends
+# ---------------------------------------------------------------------------
+
+def _foreach_nd(body, data, init_states):
+    from .. import autograd
+    from ..autograd import _TapeNode
+    from ..ndarray.ndarray import NDArray
+
+    data_list, data_single = _as_list(data)
+    state_list, state_single = _as_list(init_states)
+    d_arrs = tuple(d._data for d in data_list)
+    s_arrs = tuple(s._data for s in state_list)
+    train = autograd.is_training()
+
+    n_out_box = [None]
+
+    def scan_all(d_arrs, s_arrs):
+        def step(carry, xs):
+            with autograd.pause(train_mode=train):
+                x_nd = [NDArray(x) for x in xs]
+                s_nd = [NDArray(c) for c in carry]
+                out, new_s = body(x_nd[0] if data_single else x_nd,
+                                  s_nd[0] if state_single else s_nd)
+            out_list, out_single = _as_list(out)
+            new_list, _ = _as_list(new_s)
+            if len(new_list) != len(s_nd):
+                raise MXNetError(
+                    "foreach: body returned %d states, expected %d"
+                    % (len(new_list), len(s_nd)))
+            n_out_box[0] = (len(out_list), out_single)
+            return (tuple(s._data for s in new_list),
+                    tuple(o._data for o in out_list))
+
+        final_s, outs = lax.scan(step, s_arrs, d_arrs)
+        return outs + final_s
+
+    recording = autograd.is_recording()
+    if recording:
+        raw, vjp_fn = jax.vjp(scan_all, d_arrs, s_arrs)
+    else:
+        raw = scan_all(d_arrs, s_arrs)
+        vjp_fn = None
+    n_outputs, out_single = n_out_box[0]
+    out_nd = [NDArray(r) for r in raw[:n_outputs]]
+    state_nd = [NDArray(r) for r in raw[n_outputs:]]
+
+    if recording:
+        def tape_vjp(cots):
+            d_cots, s_cots = vjp_fn(tuple(cots))
+            return tuple(d_cots) + tuple(s_cots)
+
+        class _ForeachOp:
+            needs_rng = False
+            name = "_foreach"
+        node = _TapeNode(_ForeachOp(), data_list + state_list, tape_vjp,
+                         len(raw), len(raw),
+                         out_avals=[(r.shape, r.dtype) for r in raw])
+        for i, o in enumerate(out_nd + state_nd):
+            o._tape_node = node
+            o._tape_index = i
+
+    out_ret = out_nd[0] if out_single and n_outputs == 1 else out_nd
+    st_ret = (state_nd[0] if state_single and len(state_nd) == 1
+              else state_nd)
+    return out_ret, st_ret
+
+
+def _to_bool(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    import numpy as np
+    arr = np.asarray(x)
+    if arr.size != 1:
+        raise MXNetError("condition must be a scalar, got shape %s"
+                         % (arr.shape,))
+    return bool(arr.reshape(()))
+
+
+def _while_loop_nd(cond_fn, func, loop_vars, max_iterations):
+    """Concrete data-dependent loop (reference imperative semantics):
+    runs until cond is false or max_iterations; outputs stacked and
+    zero-padded on axis 0 to max_iterations."""
+    from ..ndarray import ndarray as _nd_mod
+    from ..ndarray.ndarray import NDArray
+
+    if max_iterations is None:
+        raise MXNetError("while_loop: max_iterations is required")
+    max_iterations = int(max_iterations)
+    state_list, state_single = _as_list(loop_vars)
+    states = list(state_list)
+    step_outputs = []
+    n_out = None
+    for _ in range(max_iterations):
+        if not _to_bool(cond_fn(*states)):
+            break
+        out, new_states = func(*states)
+        out_list, _ = _as_list(out)
+        new_list, _ = _as_list(new_states)
+        if len(new_list) != len(states):
+            raise MXNetError(
+                "while_loop: func returned %d loop_vars, expected %d"
+                % (len(new_list), len(states)))
+        if n_out is None:
+            n_out = len(out_list)
+        elif n_out != len(out_list):
+            raise MXNetError("while_loop: step_output arity changed")
+        step_outputs.append(out_list)
+        states = new_list
+
+    if n_out is None:
+        # cond never true: reference warns step_output is assumed empty
+        outputs = []
+    else:
+        outputs = []
+        for i in range(n_out):
+            rows = [so[i] for so in step_outputs]
+            stacked = _nd_mod.invoke(
+                _get_op("stack"), rows, {"axis": 0})[0] \
+                if len(rows) > 1 else rows[0].expand_dims(0)
+            pad = max_iterations - len(rows)
+            if pad:
+                zero_rows = NDArray(jnp.zeros(
+                    (pad,) + tuple(stacked.shape[1:]),
+                    stacked._data.dtype))
+                stacked = _nd_mod.invoke(
+                    _get_op("concat"), [stacked, zero_rows],
+                    {"dim": 0})[0]
+            outputs.append(stacked)
+    return outputs, (states[0] if state_single and len(states) == 1
+                     else states)
+
+
+def _cond_nd(pred, then_func, else_func):
+    out = then_func() if _to_bool(pred) else else_func()
+    out_list, single = _as_list(out)
+    return out_list[0] if single and len(out_list) == 1 else out_list
+
+
+# ---------------------------------------------------------------------------
+# dispatching frontends (exported into nd.contrib and sym.contrib)
+# ---------------------------------------------------------------------------
+
+def _is_sym(x):
+    from ..symbol import Symbol
+    if isinstance(x, (list, tuple)):
+        return any(_is_sym(i) for i in x)
+    return isinstance(x, Symbol)
+
+
+def foreach(body, data, init_states):
+    """Run `body` over axis 0 of `data`, threading loop states.
+
+    Reference: python/mxnet/ndarray/contrib.py:101 /
+    symbol/contrib.py:157; lowered to lax.scan."""
+    if _is_sym(data) or _is_sym(init_states):
+        return _foreach_sym(body, data, init_states)
+    return _foreach_nd(body, data, init_states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop (reference: ndarray/contrib.py:195 /
+    symbol/contrib.py:340); symbolic path lowers to a masked lax.scan."""
+    if _is_sym(loop_vars):
+        return _while_loop_sym(cond, func, loop_vars, max_iterations)
+    return _while_loop_nd(cond, func, loop_vars, max_iterations)
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else (reference: ndarray/contrib.py:366 /
+    symbol/contrib.py:560); symbolic path lowers to lax.cond."""
+    if _is_sym(pred):
+        return _cond_sym(pred, then_func, else_func)
+    return _cond_nd(pred, then_func, else_func)

@@ -22,7 +22,8 @@ from .registry import register, alias
 
 _UNARY = {
     "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
-    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc, "fix": jnp.fix,
+    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc,
+    "fix": jnp.trunc,
     "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
     "log1p": jnp.log1p, "expm1": jnp.expm1, "sqrt": jnp.sqrt,
     "cbrt": jnp.cbrt, "square": jnp.square,

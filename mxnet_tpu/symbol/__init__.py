@@ -41,3 +41,10 @@ def _refresh_namespaces():
 
 
 _refresh_namespaces()
+
+# higher-order control-flow frontends (reference: symbol/contrib.py
+# foreach :157, while_loop :340, cond :560)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402
+contrib.foreach = foreach
+contrib.while_loop = while_loop
+contrib.cond = cond
