@@ -60,6 +60,10 @@ from . import image
 from .model import FeedForward
 from . import contrib
 from . import rnn
+from . import operator
+# Custom registers late — regenerate nd.*/sym.* frontends to pick it up
+ndarray._refresh_namespaces()
+symbol._refresh_namespaces()
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "nd", "ndarray", "autograd",
            "random", "MXNetError", "sym", "symbol", "Symbol", "Executor",

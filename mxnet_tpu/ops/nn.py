@@ -681,8 +681,5 @@ def _identity_kl(x, *, sparseness_target=0.1, penalty=0.001, momentum=0.9):
     return x
 
 
-@register("Custom")
-def _custom(*xs, op_type):
-    raise MXNetError(
-        "Custom op %r must be invoked through mxnet_tpu.operator "
-        "(CustomOp python bridge)" % op_type)
+# `Custom` is registered by mxnet_tpu.operator (the CustomOp python
+# bridge; reference: src/operator/custom/custom.cc).

@@ -29,7 +29,7 @@ _OPS = {}
 class Op:
     __slots__ = ("name", "fn", "num_outputs", "doc", "params",
                  "needs_rng", "takes_mode", "visible_outputs", "aux_write",
-                 "input_names")
+                 "input_names", "allow_extra_params")
 
     def __init__(self, name, fn, num_outputs=1, doc=None, needs_rng=False,
                  takes_mode=False, visible_outputs=None, aux_write=None,
@@ -60,6 +60,11 @@ class Op:
             for p in sig.parameters.values()
             if p.kind == inspect.Parameter.KEYWORD_ONLY and p.name != "_mode"
         }
+        # ops with **kwargs (e.g. Custom forwarding params to the user's
+        # CustomOpProp) accept arbitrary extra params
+        self.allow_extra_params = any(
+            p.kind == inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values())
         if input_names is None:
             input_names = [
                 p.name for p in sig.parameters.values()
@@ -141,6 +146,9 @@ def apply_defaults(op: Op, params: dict) -> dict:
             # tolerate reference-style no-op params silently? No: raise, but
             # allow the common codegen extras.
             if k in ("name", "out", "ctx"):
+                continue
+            if op.allow_extra_params:
+                out[k] = v
                 continue
             raise MXNetError("op %s: unknown param %r (valid: %s)"
                              % (op.name, k, sorted(out)))

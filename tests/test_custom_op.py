@@ -1,0 +1,162 @@
+"""CustomOp bridge tests (reference: python/mxnet/operator.py:426-1101,
+tests/python/unittest/test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("sigmoid_t")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],), ()
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class ScaledFC(mx.operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x, w = in_data
+        self.assign(out_data[0], req[0],
+                    mx.nd.dot(x, w, transpose_b=True) * self.scale)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x, w = in_data
+        og = out_grad[0] * self.scale
+        self.assign(in_grad[0], req[0], mx.nd.dot(og, w))
+        self.assign(in_grad[1], req[1],
+                    mx.nd.dot(og, x, transpose_a=True))
+
+
+@mx.operator.register("scaled_fc_t")
+class ScaledFCProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0", num_hidden="0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+        self.num_hidden = int(num_hidden)
+
+    def list_arguments(self):
+        return ["data", "weight"]
+
+    def infer_shape(self, in_shape):
+        d = in_shape[0]
+        return [d, [self.num_hidden, d[1]]], \
+            [[d[0], self.num_hidden]], ()
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ScaledFC(self.scale)
+
+
+def test_custom_nd_forward():
+    x_np = np.random.RandomState(0).randn(3, 4).astype("f")
+    y = mx.nd.Custom(mx.nd.array(x_np), op_type="sigmoid_t")
+    assert np.allclose(y.asnumpy(), 1 / (1 + np.exp(-x_np)), atol=1e-6)
+
+
+def test_custom_nd_backward():
+    x_np = np.random.RandomState(0).randn(3, 4).astype("f")
+    x = mx.nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="sigmoid_t")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x_np))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-5)
+
+
+def test_custom_symbol_forward_backward():
+    x_np = np.random.RandomState(0).randn(3, 4).astype("f")
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data, op_type="sigmoid_t", name="sig")
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(x_np)},
+                  args_grad={"data": mx.nd.zeros((3, 4))})
+    o = ex.forward(is_train=True)
+    s = 1 / (1 + np.exp(-x_np))
+    assert np.allclose(o[0].asnumpy(), s, atol=1e-6)
+    ex.backward([mx.nd.ones((3, 4))])
+    assert np.allclose(ex.grad_dict["data"].asnumpy(), s * (1 - s),
+                       atol=1e-5)
+
+
+def test_custom_kwargs_and_multi_input():
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(4, 5).astype("f")
+    w_np = rng.randn(3, 5).astype("f")
+    x, w = mx.nd.array(x_np), mx.nd.array(w_np)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, w, op_type="scaled_fc_t", scale=2.0,
+                         num_hidden=3)
+        loss = y.sum()
+    loss.backward()
+    assert np.allclose(y.asnumpy(), 2 * x_np @ w_np.T, atol=1e-4)
+    assert np.allclose(x.grad.asnumpy(),
+                       2 * np.ones((4, 3)) @ w_np, atol=1e-4)
+    assert np.allclose(w.grad.asnumpy(),
+                       2 * np.ones((4, 3)).T @ x_np, atol=1e-4)
+
+
+def test_custom_symbol_auto_weight_var():
+    """Unbound prop arguments become auto-named variables that
+    simple_bind can shape-infer through the prop."""
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(4, 5).astype("f")
+    w_np = rng.randn(3, 5).astype("f")
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data=data, op_type="scaled_fc_t", scale=1.5,
+                        num_hidden=3, name="sfc")
+    assert "sfc_weight" in out.list_arguments()
+    ex = out.simple_bind(mx.cpu(), data=(4, 5))
+    ex.arg_dict["sfc_weight"][:] = mx.nd.array(w_np)
+    ex.arg_dict["data"][:] = mx.nd.array(x_np)
+    o = ex.forward(is_train=True)
+    assert np.allclose(o[0].asnumpy(), 1.5 * x_np @ w_np.T, atol=1e-4)
+    ex.backward([mx.nd.ones((4, 3))])
+    assert np.allclose(ex.grad_dict["sfc_weight"].asnumpy(),
+                       1.5 * np.ones((4, 3)).T @ x_np, atol=1e-4)
+
+
+def test_custom_in_module_fit():
+    """Custom op inside a Module training loop learns (end-to-end through
+    executor jit + pure_callback)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 6).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Custom(h, op_type="sigmoid_t", name="act")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=["softmax_label"])
+    mod.fit(it, num_epoch=40,
+            optimizer_params={"learning_rate": 1.0, "momentum": 0.9})
+    it.reset()
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.9, acc
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.zeros((2,)), op_type="never_registered_xyz")
