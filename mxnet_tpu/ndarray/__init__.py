@@ -8,7 +8,7 @@ import types as _types
 
 from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty,
                       arange, zeros_like, ones_like, concatenate, moveaxis,
-                      waitall, load, save, _as_nd)
+                      waitall, load, save, load_frombuffer, _as_nd)
 from . import sparse
 from .sparse import RowSparseNDArray, CSRNDArray
 from .register import populate as _populate

@@ -576,24 +576,34 @@ def imdecode(buf, **kw):
 
 
 def save(fname, data):
-    if isinstance(data, NDArray):
-        data = [data]
-    if isinstance(data, dict):
-        np.savez(fname, __format__="dict",
-                 **{k: v.asnumpy() for k, v in data.items()})
-    else:
-        np.savez(fname, __format__="list",
-                 **{"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)})
-    import os
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    """Save arrays in the reference's binary .params container
+    (reference: ndarray/utils.py:222 -> src/ndarray/ndarray.cc:1735);
+    files round-trip with the reference framework."""
+    from .serialization import dumps
+    with open(fname, "wb") as f:
+        f.write(dumps(data))
 
 
 def load(fname):
-    with np.load(fname, allow_pickle=False) as f:
-        fmt = str(f["__format__"])
-        if fmt == "dict":
-            return {k: array(f[k]) for k in f.files if k != "__format__"}
-        items = sorted((k for k in f.files if k != "__format__"),
-                       key=lambda k: int(k.split("_")[1]))
-        return [array(f[k]) for k in items]
+    """Load a reference-format .params file (also reads this repo's
+    older .npz checkpoints; reference: ndarray/utils.py:149)."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    return load_frombuffer(buf)
+
+
+def load_frombuffer(buf):
+    """Deserialize arrays from a byte buffer
+    (reference: ndarray/utils.py:185)."""
+    from .serialization import loads
+    if buf[:2] == b"PK":  # legacy .npz checkpoint from round 1
+        import io as _io
+        with np.load(_io.BytesIO(buf), allow_pickle=False) as f:
+            fmt = str(f["__format__"])
+            if fmt == "dict":
+                return {k: array(f[k]) for k in f.files
+                        if k != "__format__"}
+            items = sorted((k for k in f.files if k != "__format__"),
+                           key=lambda k: int(k.split("_")[1]))
+            return [array(f[k]) for k in items]
+    return loads(buf)

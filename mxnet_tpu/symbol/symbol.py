@@ -356,28 +356,41 @@ class Symbol:
     # serialization (reference: symbol.py tojson :1218, legacy_json_util)
     # ------------------------------------------------------------------
     def tojson(self):
+        """Reference-compatible graph JSON: attr values are plain strings
+        ("(3, 3)", "True", "relu"), the format the reference's
+        nnvm::Graph SaveJSON emits and legacy_json_util.cc upgrades —
+        so exported JSON loads in the reference and vice versa."""
         order = topo_order(self._entries)
         index = {id(n): i for i, n in enumerate(order)}
         nodes = []
         arg_nodes = []
+        row_ptr = [0]
         for i, node in enumerate(order):
             if node.is_variable:
                 arg_nodes.append(i)
-                nodes.append({
-                    "op": "null", "name": node.name,
-                    "attrs": {k: repr(v) for k, v in node.attrs.items()},
-                    "is_aux": node.is_aux, "inputs": []})
+                entry = {"op": "null", "name": node.name, "inputs": []}
+                attrs = {k: _attr_str(v) for k, v in node.attrs.items()}
+                if attrs:
+                    entry["attrs"] = attrs
             else:
-                nodes.append({
+                entry = {
                     "op": node.op.name, "name": node.name,
-                    "attrs": {k: repr(v) for k, v in node.params.items()},
                     "inputs": [[index[id(n)], oi, 0]
-                               for n, oi in node.inputs]})
+                               for n, oi in node.inputs]}
+                # modern reference JSON merges op params and node
+                # annotations (lr_mult/ctx_group/...) into one attrs
+                # dict; load_json re-splits by op param names
+                attrs = {k: _attr_str(v)
+                         for k, v in {**node.attrs,
+                                      **node.params}.items()}
+                if attrs:
+                    entry["attrs"] = attrs
+            nodes.append(entry)
+            row_ptr.append(row_ptr[-1] + node.n_raw())
         heads = [[index[id(n)], oi, 0] for n, oi in self._entries]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
-                           "node_row_ptr": [], "heads": heads,
-                           "attrs": {"mxnet_version": ["int", 10200],
-                                     "mxnet_tpu": ["int", 1]}},
+                           "node_row_ptr": row_ptr, "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10200]}},
                           indent=2)
 
     def save(self, fname):
@@ -479,34 +492,95 @@ def load(fname):
         return load_json(f.read())
 
 
+def _node_attrs(nd):
+    """Merged attr dict across JSON vintages (reference
+    legacy_json_util.cc upgrade path: old graphs split op params into
+    'param' and annotations into 'attr'; >=1.0 merges all into
+    'attrs')."""
+    out = {}
+    if isinstance(nd.get("param"), dict):
+        out.update(nd["param"])
+    for key in ("attr", "attrs"):
+        if isinstance(nd.get(key), dict):
+            out.update(nd[key])
+    return out
+
+
+# node annotations that are never op params (reference: nnvm node attrs
+# consumed by bind/PlaceDevice, plus our __shape__/__dtype__ markers)
+_ANNOTATION_ATTRS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                     "mirror_stage")
+
+
+def _entry_list(raw):
+    """Input/head entries: modern [node, out, version] or legacy
+    [node, out]."""
+    out = []
+    for e in raw:
+        if isinstance(e, (list, tuple)):
+            out.append((e[0], e[1] if len(e) > 1 else 0))
+        else:
+            out.append((e, 0))
+    return out
+
+
 def load_json(json_str):
+    """Load reference graph JSON (any vintage) or our own exports."""
     data = json.loads(json_str)
     raw_nodes = data["nodes"]
     built = []
-    aux_set = set()
     for nd in raw_nodes:
+        merged = {k: _parse_attr(v) for k, v in _node_attrs(nd).items()}
         if nd["op"] == "null":
-            attrs = {k: _parse_attr(v) for k, v in
-                     nd.get("attrs", {}).items()}
             node = Node(None, [], {}, nd["name"],
-                        is_aux=nd.get("is_aux", False), attrs=attrs)
+                        is_aux=nd.get("is_aux", False), attrs=merged)
         else:
             op = _reg.get(nd["op"])
-            inputs = [(built[i], oi) for i, oi, _ in nd["inputs"]]
-            params = {k: _parse_attr(v) for k, v in
-                      nd.get("attrs", {}).items()}
-            node = Node(op, inputs, params, nd["name"])
+            inputs = [(built[i], oi)
+                      for i, oi in _entry_list(nd["inputs"])]
+            if op.allow_extra_params:
+                params = {k: v for k, v in merged.items()
+                          if k not in _ANNOTATION_ATTRS
+                          and not k.startswith("__")}
+            else:
+                params = {k: v for k, v in merged.items()
+                          if k in op.params}
+            attrs = {k: v for k, v in merged.items() if k not in params}
+            # legacy graphs omit aux-state inputs (old BatchNorm nodes
+            # have 3 inputs; moving stats were implicit) — create the
+            # missing trailing variables like compose would
+            from .register import _INPUT_SPECS
+            spec_fn = _INPUT_SPECS.get(op.name)
+            if spec_fn is not None:
+                spec = spec_fn(_reg.apply_defaults(op, params))
+                while len(inputs) < len(spec):
+                    v = Node(None, [], {},
+                             "%s_%s" % (nd["name"], spec[len(inputs)]))
+                    inputs.append((v, 0))
+            node = Node(op, inputs, params, nd["name"], attrs=attrs)
             for oi, ii in (op.aux_write or {}).items():
                 if ii < len(inputs) and inputs[ii][0].is_variable:
                     inputs[ii][0].is_aux = True
         built.append(node)
     heads = data.get("heads") or [[len(built) - 1, 0, 0]]
-    return Symbol([(built[i], oi) for i, oi, _ in heads])
+    return Symbol([(built[i], oi) for i, oi in _entry_list(heads)])
+
+
+def _attr_str(v):
+    """Reference-style attr stringification: everything is a string;
+    tuples print as "(3, 3)", bools as "True", strings bare."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (list, tuple)):
+        return str(tuple(v))
+    return str(v)
 
 
 def _parse_attr(v):
     if not isinstance(v, str):
         return v
+    if v in ("true", "false"):  # dmlc-style bools in C++-written JSON
+        return v == "true"
     try:
         return ast.literal_eval(v)
     except (ValueError, SyntaxError):

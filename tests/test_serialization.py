@@ -1,0 +1,182 @@
+"""Checkpoint-format tests: binary .params container + graph JSON
+round-trip across vintages (reference: src/ndarray/ndarray.cc:1537-1762,
+src/nnvm/legacy_json_util.cc)."""
+import json
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.ndarray.sparse as sp
+
+
+def test_params_dict_roundtrip(tmp_path):
+    f = str(tmp_path / "t.params")
+    a = mx.nd.array(np.arange(6).reshape(2, 3).astype("f"))
+    b = mx.nd.array(np.array([1, 2, 3], dtype="int32"))
+    mx.nd.save(f, {"a": a, "b": b})
+    d = mx.nd.load(f)
+    assert np.allclose(d["a"].asnumpy(), a.asnumpy())
+    assert d["b"].asnumpy().dtype == np.int32
+    assert np.array_equal(d["b"].asnumpy(), [1, 2, 3])
+
+
+def test_params_list_roundtrip(tmp_path):
+    f = str(tmp_path / "t.params")
+    arrs = [mx.nd.ones((2, 2)), mx.nd.zeros((3,))]
+    mx.nd.save(f, arrs)
+    l = mx.nd.load(f)
+    assert isinstance(l, list) and len(l) == 2
+    assert np.allclose(l[0].asnumpy(), 1.0)
+
+
+def test_params_sparse_roundtrip(tmp_path):
+    f = str(tmp_path / "t.params")
+    rs = sp.RowSparseNDArray(np.eye(2, 3, dtype="f"),
+                             np.array([0, 2], "i"), (4, 3))
+    csr = sp.CSRNDArray(np.array([1.0, 2.0], "f"),
+                        np.array([0, 2], "i"),
+                        np.array([0, 1, 2], "i"), (2, 3))
+    mx.nd.save(f, {"rs": rs, "csr": csr})
+    d = mx.nd.load(f)
+    assert isinstance(d["rs"], sp.RowSparseNDArray)
+    assert isinstance(d["csr"], sp.CSRNDArray)
+    dense = d["rs"].tostype("default").asnumpy()
+    assert np.allclose(dense[0], [1, 0, 0])
+    assert np.allclose(dense[2], [0, 1, 0])
+    assert np.allclose(dense[1], 0)
+
+
+def _golden_v2_dense():
+    """Reference byte layout packed independently of the serializer."""
+    out = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+           struct.pack("<I", 0xF993FAC9),            # V2 magic
+           struct.pack("<i", 0),                     # dense stype
+           struct.pack("<I", 2), struct.pack("<2q", 2, 2),  # shape
+           struct.pack("<ii", 1, 0),                 # cpu ctx
+           struct.pack("<i", 0),                     # float32
+           np.arange(4, dtype="f").tobytes(),
+           struct.pack("<Q", 1),
+           struct.pack("<Q", 3), b"arr"]
+    return b"".join(out)
+
+
+def test_golden_reference_bytes():
+    d = mx.nd.load_frombuffer(_golden_v2_dense())
+    assert np.allclose(d["arr"].asnumpy(), [[0, 1], [2, 3]])
+
+
+def test_golden_v1_and_v0_legacy_bytes():
+    v1 = b"".join([struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+                   struct.pack("<I", 0xF993FAC8),
+                   struct.pack("<I", 1), struct.pack("<q", 3),
+                   struct.pack("<ii", 1, 0),
+                   struct.pack("<i", 4),             # int32
+                   np.array([7, 8, 9], "i").tobytes(),
+                   struct.pack("<Q", 0)])
+    g1 = mx.nd.load_frombuffer(v1)
+    assert np.array_equal(g1[0].asnumpy(), [7, 8, 9])
+
+    v0 = b"".join([struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+                   struct.pack("<I", 2),             # ndim-as-magic
+                   struct.pack("<2I", 2, 2),
+                   struct.pack("<ii", 1, 0),
+                   struct.pack("<i", 0),
+                   np.arange(4, dtype="f").tobytes(),
+                   struct.pack("<Q", 0)])
+    g0 = mx.nd.load_frombuffer(v0)
+    assert np.allclose(g0[0].asnumpy(), [[0, 1], [2, 3]])
+
+
+def test_npz_backcompat(tmp_path):
+    """Round-1 .npz checkpoints still load."""
+    f = str(tmp_path / "old.npz")
+    np.savez(f, __format__="dict", w=np.ones((2, 2), "f"))
+    d = mx.nd.load(f)
+    assert np.allclose(d["w"].asnumpy(), 1.0)
+
+
+def _legacy_vintage_json():
+    """A 2015-style graph JSON: param/attr split, 2-element input
+    entries, implicit BatchNorm aux states (shape mirrors the
+    reference's tests/python/unittest/save_000800.json layout)."""
+    nodes = [
+        {"op": "null", "param": {}, "name": "data", "inputs": [],
+         "backward_source_id": -1, "attr": {"ctx_group": "stage1"}},
+        {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "FullyConnected",
+         "param": {"no_bias": "False", "num_hidden": "8"},
+         "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+         "backward_source_id": -1, "attr": {"ctx_group": "stage1"}},
+        {"op": "null", "param": {}, "name": "bn_gamma", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "bn_beta", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "BatchNorm",
+         "param": {"eps": "0.001", "momentum": "0.9",
+                   "fix_gamma": "True"},
+         "name": "bn", "inputs": [[3, 0], [4, 0], [5, 0]],
+         "backward_source_id": -1},
+        {"op": "Activation", "param": {"act_type": "relu"},
+         "name": "relu1", "inputs": [[6, 0]], "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "softmax_label",
+         "inputs": [], "backward_source_id": -1},
+        {"op": "SoftmaxOutput",
+         "param": {"grad_scale": "1", "multi_output": "False"},
+         "name": "softmax", "inputs": [[7, 0], [8, 0]],
+         "backward_source_id": -1},
+    ]
+    return json.dumps({"nodes": nodes,
+                       "arg_nodes": [0, 1, 2, 4, 5, 8],
+                       "heads": [[9, 0]]})
+
+
+def test_legacy_json_import_and_roundtrip():
+    sym = mx.sym.load_json(_legacy_vintage_json())
+    assert "fc1_weight" in sym.list_arguments()
+    # implicit BatchNorm aux states materialized like compose would
+    assert sym.list_auxiliary_states() == ["bn_moving_mean",
+                                           "bn_moving_var"]
+    ex = sym.simple_bind(mx.cpu(), data=(2, 10), softmax_label=(2,))
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (2, 8)
+
+    # our export is string-attr JSON that reloads identically
+    js = json.loads(sym.tojson())
+    for node in js["nodes"]:
+        for v in node.get("attrs", {}).values():
+            assert isinstance(v, str)
+    sym2 = mx.sym.load_json(sym.tojson())
+    assert sym2.list_arguments() == sym.list_arguments()
+    assert sym2.list_auxiliary_states() == sym.list_auxiliary_states()
+    ex2 = sym2.simple_bind(mx.cpu(), data=(2, 10), softmax_label=(2,))
+    assert ex2.forward(is_train=False)[0].shape == (2, 8)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=16,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=["softmax_label"])
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    mod.save_checkpoint(prefix, 2)
+
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module(sym2, label_names=["softmax_label"])
+    mod2.bind(data_shapes=[("data", (16, 6))],
+              label_shapes=[("softmax_label", (16,))])
+    mod2.set_params(args, auxs)
+    it.reset()
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    assert np.allclose(p1, p2, atol=1e-6)
