@@ -80,20 +80,21 @@ class Context:
 
 
 def _devices_for(device_type):
+    # LOCAL devices only: in a multi-process (dist kvstore) run each
+    # worker's ctx ids index its own addressable devices, like the
+    # reference where every worker sees its own gpu(0)
     backend = jax.default_backend()
     if device_type == "cpu":
         if backend == "cpu":
-            return jax.devices()
+            return jax.local_devices()
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
-            return jax.devices()
+            return jax.local_devices()
     # accelerator ('tpu'/'gpu'): whatever the default accelerator backend is.
     # Under the CPU test mesh there is no accelerator; fall back to host
     # devices so tests can run tpu-targeted code paths unchanged.
-    if backend == "cpu":
-        return jax.devices()
-    return jax.devices()
+    return jax.local_devices()
 
 
 def cpu(device_id=0):
@@ -114,9 +115,10 @@ def num_gpus():
 
 
 def num_tpus():
+    # local count, consistent with Context's local-device indexing
     if jax.default_backend() == "cpu":
         return 0
-    return len(jax.devices())
+    return len(jax.local_devices())
 
 
 def current_context():

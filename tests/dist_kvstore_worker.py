@@ -1,0 +1,86 @@
+"""Worker process for test_dist_kvstore: N-process sync semantics.
+
+Mirrors the reference's nightly dist_sync_kvstore.py (:30-34 check_diff
+exact equality): every worker pushes a rank-dependent value and asserts
+the pulled result equals the exact sum, across dense fp32, fp16, big,
+and row_sparse-gathered keys, plus the updater path.
+"""
+import sys
+
+import numpy as np
+
+
+def main():
+    coordinator, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nproc, process_id=rank)
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == nproc, kv.num_workers
+    assert kv.rank == rank, (kv.rank, rank)
+    nw = kv.num_workers
+
+    # ---- dense fp32, exact equality across repeated rounds ----------
+    shape = (3, 4)
+    kv.init("dense", mx.nd.zeros(shape))
+    for rnd in range(3):
+        val = mx.nd.full(shape, rank + 1 + rnd)
+        kv.push("dense", val)
+        out = mx.nd.zeros(shape)
+        kv.pull("dense", out=out)
+        expect = sum(r + 1 + rnd for r in range(nw))
+        got = out.asnumpy()
+        assert (got == expect).all(), (rnd, got[0, 0], expect)
+
+    # ---- fp16 -------------------------------------------------------
+    kv.init("half", mx.nd.zeros(shape, dtype="float16"))
+    kv.push("half", mx.nd.full(shape, rank + 1, dtype="float16"))
+    out = mx.nd.zeros(shape, dtype="float16")
+    kv.pull("half", out=out)
+    expect = np.float16(sum(r + 1 for r in range(nw)))
+    assert (out.asnumpy() == expect).all(), out.asnumpy()[0, 0]
+    assert out.asnumpy().dtype == np.float16
+
+    # ---- big array (exercises a second compiled reduce) -------------
+    big = (129, 33)
+    kv.init("big", mx.nd.zeros(big))
+    kv.push("big", mx.nd.ones(big) * (rank + 1))
+    out = mx.nd.zeros(big)
+    kv.pull("big", out=out)
+    assert (out.asnumpy() == sum(r + 1 for r in range(nw))).all()
+
+    # ---- row_sparse pull after dense grad push ----------------------
+    emb = (8, 5)
+    kv.init("emb", mx.nd.zeros(emb))
+    grad = np.zeros(emb, "f")
+    grad[rank % 8] = rank + 1
+    kv.push("emb", mx.nd.array(grad))
+    out = mx.nd.zeros(emb)
+    rid = mx.nd.array(np.array([rank % 8], "i"))
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    expect_row = np.zeros(5, "f")
+    expect_row[:] = sum(r + 1 for r in range(nw) if r % 8 == rank % 8)
+    assert np.array_equal(out.asnumpy()[rank % 8], expect_row), \
+        out.asnumpy()[rank % 8]
+
+    # ---- updater path: identical state evolution on every rank ------
+    kv2_key = "w"
+    kv.init(kv2_key, mx.nd.ones((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(kv2_key, mx.nd.full((4,), float(rank)))
+    out = mx.nd.zeros((4,))
+    kv.pull(kv2_key, out=out)
+    # grad sum = sum(ranks); sgd: w - 0.1 * grad (wd 0)
+    expect = 1.0 - 0.1 * sum(range(nw))
+    got = out.asnumpy()
+    assert np.allclose(got, expect, atol=1e-6), (got, expect)
+
+    kv.barrier()
+    print("WORKER_%d_OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Multi-process DistKVStore test: N real processes over jax.distributed
+on the CPU backend (reference: tests/nightly/dist_sync_kvstore.py run
+via `tools/launch.py -n 4` — here the launcher is subprocess + a local
+coordinator)."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+NPROC = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_sync_kvstore_four_processes():
+    coordinator = "127.0.0.1:%d" % _free_port()
+    worker = os.path.join(os.path.dirname(__file__),
+                          "dist_kvstore_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers use their own 1-device CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coordinator, str(NPROC), str(r)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for r in range(NPROC)]
+    outs = []
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outs.append((r, p.returncode, out.decode(errors="replace")))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, rc, out in outs:
+        assert rc == 0, "worker %d failed (rc=%d):\n%s" % (r, rc, out[-3000:])
+        assert ("WORKER_%d_OK" % r) in out
