@@ -59,10 +59,14 @@ class _Conv(HybridBlock):
                 dshape = [0] * (len(kernel_size) + 2)
                 dshape[layout.find("N")] = 1
                 dshape[layout.find("C")] = in_channels
-                # weight shape: (channels, in_channels/groups, *kernel)
-                wshape = (channels,
-                          in_channels // groups if in_channels else 0) \
-                    + tuple(kernel_size)
+                from ...ops.nn import is_channels_last
+                cin = in_channels // groups if in_channels else 0
+                if is_channels_last(layout):
+                    # channels-last (NHWC family): (channels, *kernel, cin)
+                    wshape = (channels,) + tuple(kernel_size) + (cin,)
+                else:
+                    # channels-first: (channels, in_channels/groups, *kernel)
+                    wshape = (channels, cin) + tuple(kernel_size)
             else:  # Deconvolution: (in_channels, channels/groups, *kernel)
                 wshape = (in_channels,
                           channels // groups if channels else 0) \
@@ -224,7 +228,7 @@ class _Pooling(HybridBlock):
 
     def __init__(self, pool_size, strides, padding, ceil_mode=False,
                  global_pool=False, pool_type="max", count_include_pad=None,
-                 **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
@@ -236,6 +240,8 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -260,7 +266,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         assert layout == "NCW", "Only supports 'NCW' layout for now"
         super().__init__(_to_tuple(pool_size, 1), strides, padding,
-                         ceil_mode, False, "max", **kwargs)
+                         ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -271,7 +277,7 @@ class MaxPool2D(_Pooling):
         assert layout in ("NCHW", "NHWC"), \
             "Only supports 'NCHW' and 'NHWC' layout for now"
         super().__init__(_to_tuple(pool_size, 2), strides, padding,
-                         ceil_mode, False, "max", **kwargs)
+                         ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -282,7 +288,7 @@ class MaxPool3D(_Pooling):
         assert layout in ("NCDHW", "NDHWC"), \
             "Only supports 'NCDHW' and 'NDHWC' layout for now"
         super().__init__(_to_tuple(pool_size, 3), strides, padding,
-                         ceil_mode, False, "max", **kwargs)
+                         ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -293,7 +299,7 @@ class AvgPool1D(_Pooling):
         assert layout == "NCW", "Only supports 'NCW' layout for now"
         super().__init__(_to_tuple(pool_size, 1), strides, padding,
                          ceil_mode, False, "avg", count_include_pad,
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -306,7 +312,7 @@ class AvgPool2D(_Pooling):
             "Only supports 'NCHW' and 'NHWC' layout for now"
         super().__init__(_to_tuple(pool_size, 2), strides, padding,
                          ceil_mode, False, "avg", count_include_pad,
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -319,7 +325,7 @@ class AvgPool3D(_Pooling):
             "Only supports 'NCDHW' and 'NDHWC' layout for now"
         super().__init__(_to_tuple(pool_size, 3), strides, padding,
                          ceil_mode, False, "avg", count_include_pad,
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
@@ -327,7 +333,8 @@ class GlobalMaxPool1D(_Pooling):
 
     def __init__(self, layout="NCW", **kwargs):
         assert layout == "NCW", "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, "max", **kwargs)
+        super().__init__((1,), None, 0, True, True, "max", layout=layout,
+                         **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
@@ -336,7 +343,8 @@ class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
         assert layout in ("NCHW", "NHWC"), \
             "Only supports 'NCHW' and 'NHWC' layout for now"
-        super().__init__((1, 1), None, 0, True, True, "max", **kwargs)
+        super().__init__((1, 1), None, 0, True, True, "max", layout=layout,
+                         **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
@@ -345,7 +353,8 @@ class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
         assert layout in ("NCDHW", "NDHWC"), \
             "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, True, "max", layout=layout,
+                         **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
@@ -353,7 +362,8 @@ class GlobalAvgPool1D(_Pooling):
 
     def __init__(self, layout="NCW", **kwargs):
         assert layout == "NCW", "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1,), None, 0, True, True, "avg", layout=layout,
+                         **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
@@ -362,7 +372,8 @@ class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
         assert layout in ("NCHW", "NHWC"), \
             "Only supports 'NCHW' and 'NHWC' layout for now"
-        super().__init__((1, 1), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1, 1), None, 0, True, True, "avg", layout=layout,
+                         **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
@@ -371,7 +382,8 @@ class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
         assert layout in ("NCDHW", "NDHWC"), \
             "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, True, "avg", layout=layout,
+                         **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -238,12 +238,17 @@ def _conv_rule(ins, params, nodes):
         else (params["kernel"],)
     nf = params["num_filter"]
     ng = params.get("num_group", 1) or 1
+    from .ops.nn import is_channels_last, channel_axis
     layout = params.get("layout")
-    c_axis = 1 if (layout is None or layout[1] == "C") else len(data.shape) - 1
+    channels_last = is_channels_last(layout)
+    c_axis = channel_axis(layout, len(data.shape))
     cin = data.shape[c_axis]
     out = list(ins)
     if out[1] is None:
-        out[1] = _struct((nf, cin // ng) + kernel, dt)
+        # channels-last weight is (O, *kernel, I) per the NHWC convention
+        wshape = (nf,) + kernel + (cin // ng,) if channels_last \
+            else (nf, cin // ng) + kernel
+        out[1] = _struct(wshape, dt)
     if len(out) > 2 and out[2] is None:
         out[2] = _struct((nf,), dt)
     return out

@@ -78,12 +78,23 @@ def _leaky_relu(key, data, *rest, act_type="leaky", slope=0.25,
     raise MXNetError("LeakyReLU: unknown act_type %r" % act_type)
 
 
+def _f32_inner(fn, x, *a, **kw):
+    """Run fn in fp32 when x is low-precision, cast the result back.
+
+    exp/log on bf16/fp16 inputs loses enough mantissa to disturb training
+    losses; the (de)normalizing pass is tiny (class-dim tensors), so the
+    fp32 round-trip is free on TPU and the VJP also runs through fp32."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return fn(x.astype(jnp.float32), *a, **kw).astype(x.dtype)
+    return fn(x, *a, **kw)
+
+
 @register("softmax")
 def _softmax(data, *, axis=-1, temperature=None):
     x = data
     if temperature is not None and temperature != 1.0:
         x = x / temperature
-    return jax.nn.softmax(x, axis=axis)
+    return _f32_inner(jax.nn.softmax, x, axis=axis)
 
 
 @register("log_softmax")
@@ -91,7 +102,7 @@ def _log_softmax(data, *, axis=-1, temperature=None):
     x = data
     if temperature is not None and temperature != 1.0:
         x = x / temperature
-    return jax.nn.log_softmax(x, axis=axis)
+    return _f32_inner(jax.nn.log_softmax, x, axis=axis)
 
 
 @register("softmin")
@@ -245,15 +256,38 @@ def _fully_connected(data, weight, *rest, num_hidden, no_bias=False, flatten=Tru
         x = x.reshape(x.shape[0], -1)
     y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if not no_bias:
-        y = y + rest[0]
+        # bias joins in y's dtype: under mixed precision the weights are
+        # bf16 while per-channel params stay fp32 — don't let the add
+        # promote the whole activation back to fp32
+        y = y + rest[0].astype(y.dtype)
     return y
+
+
+def is_channels_last(layout):
+    """True for NWC/NHWC/NDHWC-family layout strings. The single source
+    of truth for layout discrimination — graph.py's shape rules and the
+    gluon layers import this rather than re-deriving it."""
+    return layout is not None and layout[1] != "C"
+
+
+def channel_axis(layout, ndim):
+    """Index of the channel axis for an ndim-rank tensor."""
+    return (ndim - 1) if is_channels_last(layout) else 1
 
 
 def _conv_dim_numbers(ndim, layout):
     if layout is None:
         layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
-    spatial = layout[2:] if layout[1] == "C" else layout[1:-1]
-    rhs = "OI" + spatial
+    if layout[1] == "C":
+        spatial = layout[2:]
+        rhs = "OI" + spatial
+    else:
+        # channels-last (NHWC family): weight is (O, *kernel, I) like the
+        # reference's NHWC convention (src/operator/nn/convolution.cc
+        # kNHWC weight layout). This is the MXU-preferred path: no layout
+        # transposes around convs, channels ride the 128-lane minor dim.
+        spatial = layout[1:-1]
+        rhs = "O" + spatial + "I"
     return layout, rhs, layout
 
 
@@ -280,7 +314,7 @@ def _convolution(data, weight, *rest, kernel, num_filter, stride=None,
         c_axis = lhs_spec.index("C")
         shape = [1] * y.ndim
         shape[c_axis] = bias.size
-        y = y + bias.reshape(shape)
+        y = y + bias.reshape(shape).astype(y.dtype)
     return y
 
 
@@ -326,12 +360,16 @@ def _deconvolution(data, weight, *rest, kernel, num_filter, stride=None,
 @register("Pooling")
 def _pooling(data, *, kernel=(), pool_type="max", stride=None, pad=None,
              global_pool=False, pooling_convention="valid", cudnn_off=False,
-             count_include_pad=True, p_value=2):
-    """N-D pooling (reference: nn/pooling.cc). Layout NC+spatial."""
+             count_include_pad=True, p_value=2, layout=None):
+    """N-D pooling (reference: nn/pooling.cc). Layout NC+spatial by
+    default; channels-last (NHWC family) pools over the middle axes."""
     x = data
     nd = x.ndim - 2
+    channels_last = is_channels_last(layout)
+    spatial_axes = tuple(range(1, x.ndim - 1)) if channels_last \
+        else tuple(range(2, x.ndim))
     if global_pool:
-        axes = tuple(range(2, x.ndim))
+        axes = spatial_axes
         if pool_type == "max":
             return jnp.max(x, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -346,18 +384,26 @@ def _pooling(data, *, kernel=(), pool_type="max", stride=None, pad=None,
     kernel = tuple_param(kernel, nd)
     stride = tuple_param(stride, nd) or (1,) * nd
     pad = tuple_param(pad, nd) or (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil-mode: pad right edge so ceil((x + 2p - k)/s) + 1 windows fit
-        pads = [(0, 0), (0, 0)]
-        for i in range(nd):
-            size, k, s, p = x.shape[2 + i], kernel[i], stride[i], pad[i]
+        sp_pads = []
+        for i, ax in enumerate(spatial_axes):
+            size, k, s, p = x.shape[ax], kernel[i], stride[i], pad[i]
             out = int(np.ceil((size + 2 * p - k) / s)) + 1
             need = max((out - 1) * s + k - size - p, p)
-            pads.append((p, need))
+            sp_pads.append((p, need))
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        sp_pads = [(p, p) for p in pad]
+    if channels_last:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+    else:
+        pads = [(0, 0), (0, 0)] + sp_pads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
@@ -426,8 +472,14 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     train = _mode == "train" and not use_global_stats
     if train:
-        mean = jnp.mean(x, axis=ax)
-        var = jnp.var(x, axis=ax)
+        # single-pass fp32 statistics: E[x] and E[x^2] fuse into ONE read
+        # of x (two-pass mean/var reads the activation twice — measured
+        # cost on TPU: an extra full-HBM pass per BN in fwd AND bwd)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=ax)
+        # clamp: E[x^2]-E[x]^2 can round negative for large-mean inputs,
+        # which would NaN the rsqrt and poison moving_var
+        var = jnp.maximum(jnp.mean(xf * xf, axis=ax) - mean * mean, 0.0)
         new_mm = momentum * moving_mean + (1 - momentum) * mean
         new_mv = momentum * moving_var + (1 - momentum) * var
     else:
@@ -436,8 +488,12 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     shape = [1] * x.ndim
     shape[axis % x.ndim] = x.shape[axis % x.ndim]
     inv_std = lax.rsqrt(var + eps)
-    y = (x - mean.reshape(shape)) * inv_std.reshape(shape)
-    y = y * g.reshape(shape) + beta.reshape(shape)
+    # fold into one scale+shift applied in x's dtype: the full-tensor
+    # elementwise pass (and its grad) stays bf16 when x is bf16, keeping
+    # HBM traffic minimal; the per-channel algebra stays fp32
+    a = g * inv_std
+    b = beta - mean * a
+    y = x * a.reshape(shape).astype(x.dtype) + b.reshape(shape).astype(x.dtype)
     return (y, mean, inv_std, lax.stop_gradient(new_mm),
             lax.stop_gradient(new_mv))
 

@@ -97,8 +97,16 @@ class ShardedTrainer:
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_rules=None, batch_axis=0,
                  data_names=("data",), label_names=("label",),
-                 aux_mode="train"):
+                 aux_mode="train", compute_dtype=None):
+        """compute_dtype: e.g. "bfloat16" for mixed precision — master
+        params stay fp32; weights (ndim>=2) and data inputs are cast to
+        the compute dtype inside the step, so matmuls/convs hit the MXU
+        in bf16 and activation HBM traffic halves. Per-channel params
+        (biases, BN gamma/beta), labels, aux stats and the optimizer
+        state stay fp32; grads accumulate fp32."""
         self._net = net
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
         if mesh is None:
             mesh = current_mesh()  # use_mesh() scope, if any
         self._mesh = mesh if mesh is not None else make_mesh()
@@ -176,11 +184,24 @@ class ShardedTrainer:
         fn = self._fn
         opt_update = self._opt_update
         hp = self._opt_hp
+        cd = self._compute_dtype
+        data_names = set(self._data_names)
 
         def step(params, aux, opt_state, inputs, key):
+            if cd is not None:
+                # mixed precision: cast weights + data (not labels — class
+                # indices >256 are not exact in bf16) at the step boundary
+                inputs = {k: v.astype(cd)
+                          if k in data_names and
+                          jnp.issubdtype(v.dtype, jnp.floating) else v
+                          for k, v in inputs.items()}
+
             def loss_fn(p):
+                if cd is not None:
+                    p = {k: v.astype(cd) if v.ndim >= 2 else v
+                         for k, v in p.items()}
                 outs, auxup = fn({**p, **inputs}, aux, key)
-                return jnp.mean(outs[0]), auxup
+                return jnp.mean(outs[0].astype(jnp.float32)), auxup
 
             (loss, auxup), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
