@@ -41,6 +41,13 @@ def _sum_arrays(vals):
     return out
 
 
+def _sum_jnp(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
 class KVStore:
     """Single-process KVStore (types: local, device, nccl).
 
@@ -53,6 +60,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compress_params = {"type": "none"}
+        self._compression = None  # GradientCompression when active
 
     # -- identity -------------------------------------------------------
     @property
@@ -72,7 +80,7 @@ class KVStore:
             self._data[k] = NDArray(v[0]._data if isinstance(v, (list, tuple))
                                     else v._data)
 
-    def _after_merge(self, merged):
+    def _after_merge(self, merged, key):
         """Hook between the local reduce and the store/update step;
         DistKVStore adds the cross-process allreduce here."""
         return merged
@@ -83,7 +91,18 @@ class KVStore:
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
             vals = v if isinstance(v, (list, tuple)) else [v]
-            merged = self._after_merge(_sum_arrays(list(vals)))
+            if self._compression is not None and "dist" not in self.type \
+                    and self._compression.active_for(vals[0]._data):
+                # 'device' store: each device's addend is compressed before
+                # the reduce (the reference's compressed inter-device comm,
+                # comm.h); residual per (key, device slot). Dist stores
+                # compress at the wire instead (_after_merge).
+                merged = _sum_jnp([
+                    self._compression.roundtrip((k, i), a._data)
+                    for i, a in enumerate(vals)])
+            else:
+                merged = _sum_arrays(list(vals))
+            merged = self._after_merge(merged, k)
             tgt = self._data[k]._data
             if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
                                                             None):
@@ -135,10 +154,20 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
+        """Activate 2-bit gradient compression with error feedback
+        (reference: kvstore.py set_gradient_compression,
+        src/kvstore/gradient_compression.h)."""
+        if not ("device" in self.type or "dist" in self.type):
+            raise MXNetError("Gradient compression is not supported for "
+                             "this type of kvstore")
         self._compress_params = dict(compression_params)
-        if self._compress_params.get("type") not in ("none", "2bit"):
-            raise MXNetError("unsupported gradient compression type %r"
-                             % self._compress_params.get("type"))
+        ctype = self._compress_params.get("type", "2bit")
+        if ctype == "none":
+            self._compression = None
+            return
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression.from_params(
+            self._compress_params)
 
     # -- persistence ----------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

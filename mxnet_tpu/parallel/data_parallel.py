@@ -27,6 +27,7 @@ import re
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -97,16 +98,36 @@ class ShardedTrainer:
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_rules=None, batch_axis=0,
                  data_names=("data",), label_names=("label",),
-                 aux_mode="train", compute_dtype=None):
+                 aux_mode="train", compute_dtype=None,
+                 gradient_compression=None):
         """compute_dtype: e.g. "bfloat16" for mixed precision — master
         params stay fp32; weights (ndim>=2) and data inputs are cast to
         the compute dtype inside the step, so matmuls/convs hit the MXU
         in bf16 and activation HBM traffic halves. Per-channel params
         (biases, BN gamma/beta), labels, aux stats and the optimizer
-        state stay fp32; grads accumulate fp32."""
+        state stay fp32; grads accumulate fp32.
+
+        gradient_compression: e.g. {"type": "2bit", "threshold": 0.5} —
+        the data-parallel gradient exchange becomes an explicit
+        compressed collective (shard_map over 'dp': per-device 2-bit
+        quantize with error feedback, all_gather of the packed words,
+        local dequantize+sum), 1/16 the gradient bytes on ICI/DCN.
+        Reference: src/kvstore/gradient_compression.h. Requires a pure
+        data-parallel mesh (no param_rules)."""
         self._net = net
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
+        self._grad_compression = None
+        if gradient_compression is not None:
+            gc = dict(gradient_compression)
+            if gc.get("type", "2bit") != "2bit":
+                raise MXNetError("unsupported gradient compression type %r"
+                                 % gc.get("type"))
+            if param_rules:
+                raise MXNetError("gradient_compression requires a pure "
+                                 "data-parallel mesh (no param_rules)")
+            self._grad_compression = {"threshold":
+                                      float(gc.get("threshold", 0.5))}
         if mesh is None:
             mesh = current_mesh()  # use_mesh() scope, if any
         self._mesh = mesh if mesh is not None else make_mesh()
@@ -162,6 +183,21 @@ class ShardedTrainer:
         self._step_fn = None
         self._step_count = 0
 
+        if self._grad_compression is not None:
+            # per-device error-feedback residuals: leading dp axis, one
+            # slice per mesh device (each device's residual never leaves it)
+            dp = self._dp_axis_name()
+            n_dp = self._mesh.shape[dp]
+            sh = NamedSharding(self._mesh, PartitionSpec(dp))
+            self._gc_residuals = {
+                k: jax.device_put(
+                    jnp.zeros((n_dp,) + v.shape, jnp.float32), sh)
+                for k, v in self._params.items()}
+
+    def _dp_axis_name(self):
+        return "dp" if "dp" in self._mesh.axis_names \
+            else self._mesh.axis_names[0]
+
     # -- shardings ------------------------------------------------------
     def _spec_for(self, name):
         for pat, spec in self._param_rules:
@@ -175,8 +211,7 @@ class ShardedTrainer:
 
     def _batch_sharding(self):
         spec = [None] * (self._batch_axis + 1)
-        spec[self._batch_axis] = "dp" if "dp" in self._mesh.axis_names \
-            else self._mesh.axis_names[0]
+        spec[self._batch_axis] = self._dp_axis_name()
         return NamedSharding(self._mesh, PartitionSpec(*spec))
 
     # -- compiled step --------------------------------------------------
@@ -226,10 +261,108 @@ class ShardedTrainer:
             out_shardings=(param_sh, aux_sh, opt_sh, rep),
             donate_argnums=(0, 1, 2))
 
+    def _build_step_compressed(self):
+        """Compressed-DP step: shard_map over the dp axis with an explicit
+        quantize -> all_gather(packed) -> dequantize+sum gradient
+        exchange. The optimizer update runs on the (replicated)
+        reconstructed gradient outside the shard_map."""
+        import functools
+        try:
+            from jax import shard_map as _sm
+            shard_map = functools.partial(_sm, check_vma=False)
+        except ImportError:  # older jax spelling
+            from jax.experimental.shard_map import shard_map as _sm
+            shard_map = functools.partial(_sm, check_rep=False)
+        from ..gradient_compression import quantize_2bit, dequantize_2bit
+
+        fn = self._fn
+        opt_update = self._opt_update
+        hp = self._opt_hp
+        cd = self._compute_dtype
+        data_names = set(self._data_names)
+        thr = self._grad_compression["threshold"]
+        dp = self._dp_axis_name()
+        n_dp = self._mesh.shape[dp]
+        mesh = self._mesh
+        batch_axis = self._batch_axis
+
+        def shard_grads(params, aux, inputs, residuals, key):
+            # runs per-device: local batch shard, replicated params
+            if cd is not None:
+                inputs = {k: v.astype(cd)
+                          if k in data_names and
+                          jnp.issubdtype(v.dtype, jnp.floating) else v
+                          for k, v in inputs.items()}
+
+            def loss_fn(p):
+                if cd is not None:
+                    p = {k: v.astype(cd) if v.ndim >= 2 else v
+                         for k, v in p.items()}
+                outs, auxup = fn({**p, **inputs}, aux, key)
+                return jnp.mean(outs[0].astype(jnp.float32)), auxup
+
+            (loss, auxup), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_res, gsum = {}, {}
+            for k, g in grads.items():
+                packed, r = quantize_2bit(g, residuals[k][0], thr)
+                new_res[k] = r[None]
+                allq = lax.all_gather(packed, dp)  # wire: packed words only
+                parts = [dequantize_2bit(allq[i], g.shape, thr, g.dtype)
+                         for i in range(n_dp)]
+                tot = parts[0]
+                for p_ in parts[1:]:
+                    tot = tot + p_
+                gsum[k] = tot / n_dp
+            loss = lax.pmean(loss, dp)
+            auxup = {k: lax.pmean(v, dp) for k, v in (auxup or {}).items()}
+            return loss, gsum, new_res, auxup
+
+        rep_tree = lambda t: jax.tree.map(lambda _: PartitionSpec(), t)
+        in_spec_inputs = {n: PartitionSpec(*([None] * batch_axis + [dp]))
+                          for n in self._data_names + self._label_names}
+        smapped = shard_map(
+            shard_grads, mesh=mesh,
+            in_specs=(rep_tree(self._params), rep_tree(self._aux),
+                      in_spec_inputs,
+                      jax.tree.map(lambda _: PartitionSpec(dp),
+                                   self._gc_residuals),
+                      PartitionSpec()),
+            out_specs=(PartitionSpec(), rep_tree(self._params),
+                       jax.tree.map(lambda _: PartitionSpec(dp),
+                                    self._gc_residuals),
+                       rep_tree(self._aux)))
+
+        def step(params, aux, opt_state, residuals, inputs, key):
+            loss, grads, new_res, auxup = smapped(params, aux, inputs,
+                                                  residuals, key)
+            new_params, new_state = opt_update(params, grads, opt_state,
+                                               **hp)
+            new_aux = dict(aux)
+            new_aux.update(auxup or {})
+            return new_params, new_aux, new_state, new_res, loss
+
+        rep = replicated(self._mesh)
+        param_sh = {n: rep for n in self._params}
+        aux_sh = {n: rep for n in self._aux}
+        opt_sh = _match_param_shardings(self._opt_state, param_sh, rep)
+        res_sh = {n: NamedSharding(self._mesh, PartitionSpec(dp))
+                  for n in self._gc_residuals}
+        in_sh = {n: self._batch_sharding()
+                 for n in self._data_names + self._label_names}
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(param_sh, aux_sh, opt_sh, res_sh, in_sh, None),
+            out_shardings=(param_sh, aux_sh, opt_sh, res_sh, rep),
+            donate_argnums=(0, 1, 2, 3))
+
     def step(self, *batch_and_labels):
         """Run one fused train step; returns the scalar loss NDArray."""
         if self._step_fn is None:
-            self._build_step()
+            if self._grad_compression is not None:
+                self._build_step_compressed()
+            else:
+                self._build_step()
         names = self._data_names + self._label_names
         if len(batch_and_labels) != len(names):
             raise MXNetError("step expects %s" % (names,))
@@ -239,8 +372,13 @@ class ShardedTrainer:
             arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             inputs[n] = jax.device_put(arr, sh)
         key = _random.next_key() if self._needs_rng else None
-        self._params, self._aux, self._opt_state, loss = self._step_fn(
-            self._params, self._aux, self._opt_state, inputs, key)
+        if self._grad_compression is not None:
+            (self._params, self._aux, self._opt_state, self._gc_residuals,
+             loss) = self._step_fn(self._params, self._aux, self._opt_state,
+                                   self._gc_residuals, inputs, key)
+        else:
+            self._params, self._aux, self._opt_state, loss = self._step_fn(
+                self._params, self._aux, self._opt_state, inputs, key)
         self._step_count += 1
         return NDArray(loss)
 

@@ -78,6 +78,28 @@ def main():
     got = out.asnumpy()
     assert np.allclose(got, expect, atol=1e-6), (got, expect)
 
+    # ---- 2-bit gradient compression over the wire -------------------
+    # (reference: nightly dist_sync_kvstore.py compressed section +
+    # gradient_compression.h semantics). Threshold 1.0, each worker
+    # pushes 0.7 per round; the error-feedback residual makes the
+    # decoded per-worker sequence [0, 1.0, 1.0] (acc 0.7 -> 1.4 -> 1.1),
+    # so the pulled (stored, not accumulated) value is [0, nw, nw].
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    cshape = (64, 4)
+    kvc.init("cmp", mx.nd.zeros(cshape))
+    for rnd, per_worker in enumerate([0.0, 1.0, 1.0]):
+        kvc.push("cmp", mx.nd.full(cshape, 0.7))
+        out = mx.nd.zeros(cshape)
+        kvc.pull("cmp", out=out)
+        expect = per_worker * nw
+        got = out.asnumpy()
+        assert np.allclose(got, expect, atol=1e-5), (rnd, got[0, 0], expect)
+    # bytes on the wire must be 16x smaller than the dense fp32 payload
+    dense_bytes = int(np.prod(cshape)) * 4
+    assert kvc.last_wire_bytes * 16 <= dense_bytes + 64, \
+        (kvc.last_wire_bytes, dense_bytes)
+
     kv.barrier()
     print("WORKER_%d_OK" % rank)
 
