@@ -1,0 +1,108 @@
+"""ImageNet-style training on record files — the reference's flagship
+example (example/image-classification/train_imagenet.py + common/fit.py).
+
+Input: an ImageNet .rec (pack with tools/im2rec or the reference's
+im2rec) via the threaded mx.io.ImageRecordIter; or --benchmark 1 for
+synthetic data (reference common/fit.py benchmark mode).
+
+TPU configuration: NHWC layout + bf16 mixed precision + one fused XLA
+program per step (see PERF.md). The input pipeline (C++ record loader ->
+N decode threads -> prefetch queue) runs on host cores concurrently with
+the device step.
+
+Usage:
+  python train_imagenet.py --benchmark 1                 # synthetic
+  python train_imagenet.py --data-train train.rec        # real records
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet50_v1")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="stop an epoch early (0 = full epoch)")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=1e-4)
+    p.add_argument("--data-train", default=None, help=".rec file")
+    p.add_argument("--preprocess-threads", type=int, default=8)
+    p.add_argument("--benchmark", type=int, default=0)
+    p.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"])
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    c, h, w = map(int, args.image_shape.split(","))
+    nhwc = args.layout == "NHWC"
+    net = getattr(vision, args.network)(classes=args.num_classes,
+                                        layout=args.layout)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        net.initialize()
+        shape = (1, h, w, c) if nhwc else (1, c, h, w)
+        net(mx.nd.zeros(shape))
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    st = ShardedTrainer(
+        net, lambda o, l: loss(o, l), "sgd",
+        {"learning_rate": args.lr, "momentum": args.momentum,
+         "wd": args.wd},
+        mesh=make_mesh({"dp": len(jax.devices())}),
+        compute_dtype=None if args.dtype == "float32" else args.dtype)
+
+    if args.benchmark or not args.data_train:
+        rng = np.random.RandomState(0)
+        bshape = (args.batch_size, h, w, c) if nhwc \
+            else (args.batch_size, c, h, w)
+        x = rng.randn(*bshape).astype("float32")
+        y = (rng.rand(args.batch_size) * args.num_classes).astype("f")
+        batches = [(x, y)] * (args.max_batches or 50)
+
+        def epoch_iter():
+            return iter(batches)
+    else:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=(c, h, w),
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, layout=args.layout,
+            preprocess_threads=args.preprocess_threads,
+            round_batch=False)
+
+        def epoch_iter():
+            it.reset()
+            return ((b.data[0], b.label[0]) for b in it)
+
+    for epoch in range(args.num_epochs):
+        t0 = time.perf_counter()
+        n, last = 0, None
+        for i, (xb, yb) in enumerate(epoch_iter()):
+            last = st.step(xb, yb)
+            n += args.batch_size
+            if args.max_batches and i + 1 >= args.max_batches:
+                break
+        last.wait_to_read()
+        dt = time.perf_counter() - t0
+        print("epoch %d: %.1f img/s, loss %.4f"
+              % (epoch, n / dt, float(last.asnumpy())))
+    st.copy_params_to_net()
+
+
+if __name__ == "__main__":
+    main()

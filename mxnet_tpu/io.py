@@ -20,6 +20,7 @@ from .base import MXNetError
 from .ndarray import NDArray, array as nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter",
            "ResizeIter", "PrefetchingIter", "MNISTIter"]
 
 
@@ -446,3 +447,12 @@ class MNISTIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+def __getattr__(name):
+    # ImageRecordIter lives in io_record.py (threaded pipeline); lazy
+    # import keeps `import mxnet_tpu` light
+    if name == "ImageRecordIter":
+        from .io_record import ImageRecordIter
+        return ImageRecordIter
+    raise AttributeError(name)
