@@ -43,6 +43,7 @@ def _bind(lib):
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
     lib.MXTEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.MXTEngineWaitForAll.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineSetCallbackError.argtypes = [ctypes.c_char_p]
 
     lib.MXTRecordIOGetLastError.restype = ctypes.c_char_p
     lib.MXTRecordReaderCreate.restype = ctypes.c_void_p
@@ -103,7 +104,9 @@ def ensure_built(quiet=True):
     return _try_load()
 
 
-_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+# returns 0 on success, nonzero after reporting via
+# MXTEngineSetCallbackError — how Python exceptions cross the C boundary
+_CB_TYPE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
 
 
 class NativeEngine:
@@ -142,6 +145,11 @@ class NativeEngine:
         def trampoline(_arg, _id=cb_id):
             try:
                 fn()
+                return 0
+            except BaseException as e:  # -> engine exception plumbing
+                msg = "%s: %s" % (type(e).__name__, e)
+                self._lib.MXTEngineSetCallbackError(msg.encode())
+                return -1
             finally:
                 with self._cb_lock:
                     self._dead.append(_id)

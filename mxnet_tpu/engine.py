@@ -96,3 +96,13 @@ def host_engine(num_workers=None):
                                           "4"))
             _host_engine = _native.NativeEngine(n)
         return _host_engine
+
+
+def _waitall_native():
+    """Drain the host engine if one exists (no-op otherwise); part of the
+    nd.waitall() fence. Raises any exception captured by the engine's
+    workers (reference: ThreadedEngine rethrow-at-WaitForAll)."""
+    with _host_engine_lock:
+        eng = _host_engine
+    if eng is not None:
+        eng.wait_for_all()

@@ -16,6 +16,7 @@ jit-cached XLA executables, plus autograd tape recording via jax.vjp.
 from __future__ import annotations
 
 import functools
+import weakref
 
 import numpy as np
 import jax
@@ -28,6 +29,11 @@ from ..ops import registry as _reg
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "concatenate", "moveaxis", "waitall", "imdecode",
            "load", "save"]
+
+
+# every live NDArray, so waitall() can fence on all in-flight results
+# (reference: Engine::WaitForAll orders against every dispatched op)
+_live_arrays = weakref.WeakSet()
 
 
 class NDArray:
@@ -48,6 +54,7 @@ class NDArray:
         self._tape_node = None
         self._tape_index = 0
         self._stype = _stype
+        _live_arrays.add(self)
 
     # ------------------------------------------------------------------
     # properties
@@ -559,10 +566,21 @@ def moveaxis(tensor, source, destination):
 
 def waitall():
     """Block until all pending computation completes (reference:
-    MXNDArrayWaitAll). JAX's async dispatch exposes no global barrier, so
-    this is a no-op fence kept for API parity; per-array wait_to_read is
-    the real sync point."""
-    (jnp.zeros(()) + 0).block_until_ready()
+    MXNDArrayWaitAll -> Engine::WaitForAll). A TRUE fence: blocks on the
+    current buffer of every live NDArray (JAX async dispatch), flushes
+    effectful computations, and drains the native host engine."""
+    for arr in list(_live_arrays):
+        data = arr._data
+        if isinstance(data, jax.Array):
+            try:
+                data.block_until_ready()
+            except Exception:
+                # deleted/donated buffers: their producing computation has
+                # necessarily completed
+                pass
+    jax.effects_barrier()
+    from .. import engine as _engine
+    _engine._waitall_native()
 
 
 def imdecode(buf, **kw):

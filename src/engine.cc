@@ -41,6 +41,9 @@ struct Var {
   std::deque<Block> queue;   // blocked ops, in push order
   int pending_reads = 0;     // running/dispatched reads
   bool pending_write = false;  // a write is running/dispatched
+  // exception attached by a failed writer (reference: threaded_engine.h
+  // :179 var exception refs); poisons dependent ops until rethrown
+  std::exception_ptr ex;
 };
 
 struct Opr {
@@ -48,6 +51,9 @@ struct Opr {
   std::vector<Var*> const_vars;
   std::vector<Var*> mut_vars;
   std::atomic<int> wait{0};
+  // sync ops (WaitForVar notifications) always run, even when an input
+  // var is poisoned — the waiter must wake to receive the rethrow
+  bool is_sync = false;
 };
 
 class Engine {
@@ -79,7 +85,7 @@ class Engine {
   }
 
   void Push(std::function<void()> fn, const std::vector<int64_t>& cvars_in,
-            const std::vector<int64_t>& mvars_in) {
+            const std::vector<int64_t>& mvars_in, bool is_sync = false) {
     // dedup within each set; overlapping const/mutable would deadlock on
     // the op's own read claim (the reference CHECK-fails here too)
     std::vector<int64_t> cvars = cvars_in, mvars = mvars_in;
@@ -96,6 +102,7 @@ class Engine {
     }
     Opr* op = new Opr();
     op->fn = std::move(fn);
+    op->is_sync = is_sync;
     {
       std::lock_guard<std::mutex> lk(vm_);
       for (int64_t id : cvars) op->const_vars.push_back(vars_.at(id));
@@ -138,17 +145,66 @@ class Engine {
       std::lock_guard<std::mutex> lk(m);
       done = true;
       cv.notify_all();
-    }, {var}, {});
-    std::unique_lock<std::mutex> lk(m);
-    cv.wait(lk, [&]() { return done; });
+    }, {var}, {}, /*is_sync=*/true);
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv.wait(lk, [&]() { return done; });
+    }
+    // rethrow the var's attached exception, if any (reference:
+    // threaded_engine.cc:464 ThrowException at WaitForVar)
+    Var* v = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(vm_);
+      auto it = vars_.find(var);
+      if (it != vars_.end()) v = it->second;
+    }
+    if (v) {
+      std::exception_ptr ex;
+      {
+        std::lock_guard<std::mutex> lk(v->m);
+        ex = v->ex;
+        v->ex = nullptr;
+      }
+      if (ex) std::rethrow_exception(ex);
+    }
   }
 
   void WaitForAll() {
-    std::unique_lock<std::mutex> lk(done_m_);
-    done_cv_.wait(lk, [this]() { return pending_.load() == 0; });
+    {
+      std::unique_lock<std::mutex> lk(done_m_);
+      done_cv_.wait(lk, [this]() { return pending_.load() == 0; });
+    }
+    // rethrow the first captured exception (reference:
+    // threaded_engine.h:256 global exception refs, rethrown at
+    // WaitForAll); clears all poison so the engine is reusable
+    std::exception_ptr ex;
+    {
+      std::lock_guard<std::mutex> lk(ex_m_);
+      if (!global_ex_.empty()) {
+        ex = global_ex_.front();
+        global_ex_.clear();
+      }
+    }
+    if (ex) {
+      std::lock_guard<std::mutex> lk(vm_);
+      for (Var* v : all_vars_) {
+        std::lock_guard<std::mutex> vl(v->m);
+        v->ex = nullptr;
+      }
+      std::rethrow_exception(ex);
+    }
   }
 
  private:
+  void Poison(Opr* op, std::exception_ptr ex) {
+    for (Var* v : op->mut_vars) {
+      std::lock_guard<std::mutex> lk(v->m);
+      if (!v->ex) v->ex = ex;
+    }
+    std::lock_guard<std::mutex> lk(ex_m_);
+    global_ex_.push_back(ex);
+  }
+
   void DecWait(Opr* op) {
     if (op->wait.fetch_sub(1) == 1) {
       {
@@ -212,7 +268,29 @@ class Engine {
         op = ready_.front();
         ready_.pop();
       }
-      op->fn();
+      // poisoned-input check: an op depending on a failed var does not
+      // run; the exception propagates to its outputs (reference:
+      // threaded_engine.h OnStartCompleted exception forwarding)
+      std::exception_ptr in_ex;
+      for (Var* v : op->const_vars) {
+        std::lock_guard<std::mutex> lk(v->m);
+        if (v->ex) { in_ex = v->ex; break; }
+      }
+      if (!in_ex) {
+        for (Var* v : op->mut_vars) {
+          std::lock_guard<std::mutex> lk(v->m);
+          if (v->ex) { in_ex = v->ex; break; }
+        }
+      }
+      if (in_ex && !op->is_sync) {
+        Poison(op, in_ex);
+      } else {
+        try {
+          op->fn();
+        } catch (...) {
+          Poison(op, std::current_exception());
+        }
+      }
       for (Var* v : op->const_vars) CompleteRead(v);
       for (Var* v : op->mut_vars) CompleteWrite(v);
       delete op;
@@ -237,6 +315,9 @@ class Engine {
   std::atomic<int64_t> pending_{0};
   std::mutex done_m_;
   std::condition_variable done_cv_;
+
+  std::mutex ex_m_;
+  std::vector<std::exception_ptr> global_ex_;
 };
 
 }  // namespace mxtpu
@@ -266,7 +347,16 @@ int64_t MXTEngineNewVar(void* h) {
   return static_cast<mxtpu::Engine*>(h)->NewVar();
 }
 
-typedef void (*mxt_engine_cb)(void* arg);
+// callbacks return 0 on success; on failure they first record a message
+// via MXTEngineSetCallbackError (thread-local) and return nonzero — the
+// bridge for Python-side exceptions, which cannot cross the C boundary
+typedef int (*mxt_engine_cb)(void* arg);
+
+static thread_local std::string g_cb_error;
+
+void MXTEngineSetCallbackError(const char* msg) {
+  g_cb_error = msg ? msg : "callback error";
+}
 
 int MXTEnginePush(void* h, mxt_engine_cb fn, void* arg,
                   const int64_t* cvars, int n_const,
@@ -275,7 +365,14 @@ int MXTEnginePush(void* h, mxt_engine_cb fn, void* arg,
     std::vector<int64_t> cv(cvars, cvars + n_const);
     std::vector<int64_t> mv(mvars, mvars + n_mut);
     static_cast<mxtpu::Engine*>(h)->Push(
-        [fn, arg]() { fn(arg); }, cv, mv);
+        [fn, arg]() {
+          g_cb_error.clear();
+          if (fn(arg) != 0) {
+            throw std::runtime_error(
+                g_cb_error.empty() ? "engine callback failed"
+                                   : g_cb_error);
+          }
+        }, cv, mv);
     return 0;
   } catch (const std::exception& e) {
     g_last_error = e.what();
