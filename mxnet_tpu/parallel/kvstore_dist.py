@@ -43,14 +43,21 @@ def init_distributed(coordinator_address=None, num_processes=None,
     global _dist_initialized
     if _dist_initialized:
         return
-    kwargs = {}
-    if coordinator_address is not None:
-        kwargs = dict(coordinator_address=coordinator_address,
-                      num_processes=num_processes, process_id=process_id)
-    elif not os.environ.get("JAX_COORDINATOR_ADDRESS") and \
-            not os.environ.get("COORDINATOR_ADDRESS"):
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("JAX_COORDINATOR_ADDRESS") or \
+            env.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None:
         return  # single-process run
-    jax.distributed.initialize(**kwargs)
+    if num_processes is None:
+        n = env.get("JAX_NUM_PROCESSES") or env.get("DMLC_NUM_WORKER")
+        num_processes = int(n) if n else None
+    if process_id is None:
+        r = env.get("JAX_PROCESS_ID") or env.get("DMLC_WORKER_ID")
+        process_id = int(r) if r else None
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
     _dist_initialized = True
 
 

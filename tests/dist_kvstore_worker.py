@@ -11,11 +11,19 @@ import numpy as np
 
 
 def main():
-    coordinator, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import os
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=nproc, process_id=rank)
+    if len(sys.argv) > 3:          # explicit argv mode (direct test run)
+        coordinator, nproc, rank = (sys.argv[1], int(sys.argv[2]),
+                                    int(sys.argv[3]))
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nproc, process_id=rank)
+    else:                          # env mode (under tools/launch.py)
+        from mxnet_tpu.parallel.kvstore_dist import init_distributed
+        init_distributed()
+        nproc = int(os.environ["DMLC_NUM_WORKER"])
+        rank = int(os.environ["DMLC_WORKER_ID"])
     import mxnet_tpu as mx
 
     kv = mx.kv.create("dist_sync")

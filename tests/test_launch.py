@@ -1,0 +1,37 @@
+"""tools/launch.py: the local launcher end-to-end.
+
+Reference: tools/launch.py local mode — here it must start N workers
+with DMLC_*/JAX_* rendezvous env and reap their exit codes; the worker
+is the same dist kvstore script the subprocess harness uses, now running
+in env mode.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launcher(n, extra_cmd):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n)] + extra_cmd,
+        capture_output=True, text=True, timeout=240, env=env)
+
+
+def test_launch_local_runs_dist_worker():
+    r = _run_launcher(2, [sys.executable,
+                          os.path.join(REPO, "tests",
+                                       "dist_kvstore_worker.py")])
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    assert "WORKER_0_OK" in r.stdout
+    assert "WORKER_1_OK" in r.stdout
+
+
+def test_launch_propagates_failure():
+    r = _run_launcher(2, [sys.executable, "-c", "import sys; sys.exit(7)"])
+    assert r.returncode == 7

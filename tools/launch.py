@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py:71-105).
+
+Launches N copies of a training command with the rendezvous environment
+prepared. The reference starts scheduler + servers + workers over
+ps-lite; the TPU-native runtime is SPMD over jax.distributed, so the
+launcher's job collapses to: pick a coordinator address, start N worker
+processes, propagate rank/world/coordinator env, forward output, and
+reap failures.
+
+Environment exported to each worker (both namings, so reference scripts
+keep working):
+  DMLC_ROLE=worker  DMLC_NUM_WORKER=<n>  DMLC_WORKER_ID=<rank>
+  JAX_COORDINATOR_ADDRESS=<host:port>  JAX_NUM_PROCESSES=<n>
+  JAX_PROCESS_ID=<rank>
+
+Modes:
+  local (default): all workers on this host.
+  ssh: one worker per line of --hostfile (requires passwordless ssh;
+       reference ssh mode).
+
+Usage:
+  tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(base, coordinator, n, rank):
+    env = dict(base)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(n),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    return env
+
+
+def _pump(prefix, stream, out):
+    for line in iter(stream.readline, b""):
+        out.write("%s%s" % (prefix, line.decode(errors="replace")))
+        out.flush()
+
+
+def launch_local(n, command, env=None):
+    """Run n local worker processes; returns the first nonzero exit code
+    (0 if all succeeded)."""
+    coordinator = "127.0.0.1:%d" % _free_port()
+    base = env or os.environ
+    procs, pumps = [], []
+    for rank in range(n):
+        p = subprocess.Popen(command,
+                             env=_worker_env(base, coordinator, n, rank),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_pump, args=("[%d] " % rank, p.stdout,
+                                                 sys.stdout), daemon=True)
+        t.start()
+        procs.append(p)
+        pumps.append(t)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            if p.returncode and not rc:
+                rc = p.returncode
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        rc = 130
+    for t in pumps:
+        t.join(timeout=2)
+    return rc
+
+
+def launch_ssh(hosts, n, command, env=None):
+    """One worker per host line (reference ssh mode). The coordinator is
+    host 0 on a fixed port; env is passed inline on the remote command
+    line."""
+    if len(hosts) < n:
+        raise SystemExit("hostfile has %d hosts, need %d" % (len(hosts), n))
+    coordinator = "%s:%d" % (hosts[0], 29500)
+    procs = []
+    for rank in range(n):
+        envs = _worker_env({}, coordinator, n, rank)
+        envstr = " ".join("%s=%s" % kv for kv in envs.items())
+        remote = "cd %s && env %s %s" % (
+            os.getcwd(), envstr, " ".join(command))
+        p = subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                              hosts[rank], remote])
+        procs.append(p)
+    rc = 0
+    for p in procs:
+        p.wait()
+        if p.returncode and not rc:
+            rc = p.returncode
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("-H", "--hostfile", default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher == "local":
+        rc = launch_local(args.num_workers, args.command)
+    else:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        rc = launch_ssh(hosts, args.num_workers, args.command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
