@@ -16,6 +16,7 @@ jit-cached XLA executables, plus autograd tape recording via jax.vjp.
 from __future__ import annotations
 
 import functools
+import time
 import weakref
 
 import numpy as np
@@ -453,6 +454,9 @@ def invoke(op, inputs, params, name=None):
     if op.needs_rng:
         arrays = [_random.next_key()] + arrays
 
+    from .. import profiler as _prof
+    prof_t0 = time.perf_counter() if _prof._active() else None
+
     recording = autograd.is_recording()
     if recording:
         pdict = dict(hparams)
@@ -485,6 +489,8 @@ def invoke(op, inputs, params, name=None):
 
     if recording:
         autograd._record(op, inputs, outputs, raw, vjp_fn)
+    if prof_t0 is not None:
+        _prof.record_op(op.name, prof_t0, time.perf_counter())
     return outputs
 
 

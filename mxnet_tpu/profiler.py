@@ -1,20 +1,35 @@
 """Profiler (reference: src/profiler/, python/mxnet/profiler.py).
 
-The reference emits chrome://tracing JSON from engine hooks. TPU-native:
-jax.profiler emits full XLA/TPU traces viewable in TensorBoard/Perfetto —
-strictly more detail than the reference's per-op wall times. This module
-keeps the reference's Python API shape (set_config/set_state/dump plus
-scoped Task/Frame/Marker) on top of jax.profiler.
+Two layers, mirroring the reference's split:
+
+1. Device profile: jax.profiler emits full XLA/TPU traces (TensorBoard/
+   Perfetto) — strictly more detail than the reference's per-op GPU
+   times. Controlled by set_state/start/stop.
+2. Host profile: the reference's chrome://tracing JSON
+   (src/profiler/profiler.h:87 EmitEvents) + per-op aggregate table
+   (:332 AggregateStats). Scoped objects (Task/Frame/Event) and eager op
+   dispatch record host events; dump() writes `<filename>.json` in
+   Chrome trace format; dumps() formats the aggregate table.
+
+Eager-op rows measure host dispatch time (the device work is async —
+use layer 1 for device truth), like the reference's CPU lanes.
 """
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
 
 import jax
 
-_config = {"filename": "/tmp/mxtpu_profile", "profile_all": False}
+_config = {"filename": "/tmp/mxtpu_profile", "profile_all": False,
+           "profile_imperative": True, "aggregate_stats": True}
 _running = {"on": False}
 _aggregate = {}
+_events = []
+_lock = threading.Lock()
+_t_origin = time.perf_counter()
 
 
 def set_config(**kwargs):
@@ -40,22 +55,64 @@ def stop():
     set_state("stop")
 
 
+def _active():
+    return _running["on"]
+
+
+def _record_event(name, t0, t1, cat="op"):
+    ev = {"name": name, "ph": "X", "cat": cat,
+          "ts": (t0 - _t_origin) * 1e6, "dur": (t1 - t0) * 1e6,
+          "pid": os.getpid(), "tid": threading.get_ident() & 0xffff}
+    with _lock:
+        _events.append(ev)
+        calls, total = _aggregate.get(name, (0, 0.0))
+        _aggregate[name] = (calls + 1, total + (t1 - t0))
+
+
+def record_op(name, t0, t1):
+    """Hook for eager op dispatch (ndarray.invoke)."""
+    if _running["on"] and _config.get("profile_imperative"):
+        _record_event(name, t0, t1, cat="operator")
+
+
 def dump(finished=True, profile_process="worker"):
+    """Stop the device trace and write the host Chrome-trace JSON to
+    `<filename>.json` (reference: MXDumpProfile -> profiler.h:87 emits
+    chrome://tracing events). Returns the path written."""
     set_state("stop")
+    path = _config["filename"] + ".json"
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+             "args": {"name": "mxnet_tpu host"}}]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 def dumps(reset=False):
-    """Aggregate stats string (reference: MXAggregateProfileStatsPrint)."""
-    lines = ["%-40s %10s %12s" % ("Name", "Calls", "Total(ms)")]
-    for name, (calls, total) in sorted(_aggregate.items()):
-        lines.append("%-40s %10d %12.3f" % (name, calls, total * 1e3))
-    if reset:
-        _aggregate.clear()
+    """Aggregate stats string (reference: MXAggregateProfileStatsPrint,
+    profiler.h:332)."""
+    with _lock:
+        items = sorted(_aggregate.items())
+        if reset:
+            _aggregate.clear()
+    lines = ["%-40s %10s %12s %12s" % ("Name", "Calls", "Total(ms)",
+                                       "Avg(ms)")]
+    for name, (calls, total) in items:
+        lines.append("%-40s %10d %12.3f %12.3f"
+                     % (name, calls, total * 1e3, total * 1e3 / calls))
     return "\n".join(lines)
 
 
 class _Scope:
     """User-scoped profiling objects (reference: profiler.py:210-400)."""
+
+    _cat = "scope"
 
     def __init__(self, name):
         self.name = name
@@ -70,9 +127,9 @@ class _Scope:
     def stop(self):
         if self._tm is not None:
             self._tm.__exit__(None, None, None)
-            calls, total = _aggregate.get(self.name, (0, 0.0))
-            _aggregate[self.name] = (calls + 1,
-                                     total + time.perf_counter() - self._t0)
+            self._tm = None
+            _record_event(self.name, self._t0, time.perf_counter(),
+                          cat=self._cat)
 
     def __enter__(self):
         self.start()
@@ -84,16 +141,22 @@ class _Scope:
 
 
 class Task(_Scope):
+    _cat = "task"
+
     def __init__(self, domain=None, name="task"):
         super().__init__(name)
 
 
 class Frame(_Scope):
+    _cat = "frame"
+
     def __init__(self, domain=None, name="frame"):
         super().__init__(name)
 
 
 class Event(_Scope):
+    _cat = "event"
+
     def __init__(self, name="event"):
         super().__init__(name)
 
@@ -103,7 +166,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        pass
+        now = time.perf_counter()
+        _record_event(self.name, now, now, cat="marker")
 
 
 class Counter:

@@ -1,0 +1,118 @@
+"""SequentialModule and PythonModule tests.
+
+Reference behaviors: sequential_module.py (chained bind/forward/backward
+with take_labels meta) and python_module.py (PythonLossModule supplying
+gradients from Python).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _toy_data(n=64, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype("float32")
+    y = (np.abs(x.sum(1)).astype("int64") % classes).astype("float32")
+    return mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                             label_name="softmax_label")
+
+
+class TestSequentialModule:
+    def _build(self):
+        # net1: features; net2: classifier+loss (takes labels)
+        d1 = mx.sym.var("data")
+        f = mx.sym.FullyConnected(d1, num_hidden=16, name="fc1")
+        f = mx.sym.Activation(f, act_type="relu", name="relu1")
+        net1 = f
+        d2 = mx.sym.var("fc1_relu")
+        g = mx.sym.FullyConnected(d2, num_hidden=4, name="fc2")
+        net2 = mx.sym.SoftmaxOutput(g, name="softmax")
+        m1 = mx.mod.Module(net1, data_names=("data",), label_names=None)
+        m2 = mx.mod.Module(net2, data_names=("fc1_relu",),
+                           label_names=("softmax_label",))
+        seq = mx.mod.SequentialModule()
+        seq.add(m1).add(m2, take_labels=True, auto_wiring=True)
+        return seq
+
+    def test_fit_decreases_loss(self):
+        seq = self._build()
+        it = _toy_data()
+        metric = mx.metric.Accuracy()
+        seq.fit(it, num_epoch=3, eval_metric=metric,
+                optimizer_params={"learning_rate": 0.1})
+        assert seq.params_initialized and seq.binded
+
+    def test_forward_shapes_and_predict(self):
+        seq = self._build()
+        it = _toy_data()
+        seq.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        seq.init_params()
+        batch = next(iter(it))
+        seq.forward(batch, is_train=False)
+        out = seq.get_outputs()[0]
+        assert out.shape == (16, 4)
+        assert seq.output_shapes[0][1] == (16, 4)
+
+    def test_duplicate_param_names_rejected(self):
+        d = mx.sym.var("data")
+        net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+        m1 = mx.mod.Module(net, label_names=None)
+        m2 = mx.mod.Module(mx.sym.FullyConnected(
+            mx.sym.var("fc_output"), num_hidden=4, name="fc"),
+            data_names=("fc_output",), label_names=None)
+        seq = mx.mod.SequentialModule()
+        seq.add(m1).add(m2, auto_wiring=True)
+        seq.bind(data_shapes=[("data", (8, 8))])
+        with pytest.raises(Exception):
+            seq.init_params()
+
+
+class TestPythonLossModule:
+    def test_python_loss_head_trains(self):
+        """Module (features) + PythonLossModule (softmax CE gradient in
+        python) — the reference's python_module example composition."""
+        d = mx.sym.var("data")
+        net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+        feat = mx.mod.Module(net, label_names=None)
+
+        def ce_grad(scores, labels):
+            s = scores.asnumpy()
+            s = np.exp(s - s.max(1, keepdims=True))
+            s /= s.sum(1, keepdims=True)
+            lbl = labels.asnumpy().astype(int)
+            s[np.arange(len(lbl)), lbl] -= 1.0
+            return mx.nd.array(s / len(lbl))
+
+        loss = mx.mod.PythonLossModule(grad_func=ce_grad)
+        seq = mx.mod.SequentialModule()
+        seq.add(feat).add(loss, take_labels=True, auto_wiring=True)
+        it = _toy_data()
+        seq.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        seq.init_params()
+        seq.init_optimizer(optimizer_params={"learning_rate": 0.5})
+
+        def nll():
+            it.reset()
+            tot, n = 0.0, 0
+            for b in it:
+                seq.forward(b, is_train=False)
+                s = seq.get_outputs()[0].asnumpy()
+                p = np.exp(s - s.max(1, keepdims=True))
+                p /= p.sum(1, keepdims=True)
+                lbl = b.label[0].asnumpy().astype(int)
+                tot += -np.log(p[np.arange(len(lbl)), lbl] + 1e-9).sum()
+                n += len(lbl)
+            return tot / n
+
+        before = nll()
+        for _ in range(5):
+            it.reset()
+            for b in it:
+                seq.forward(b, is_train=True)
+                seq.backward()
+                seq.update()
+        after = nll()
+        assert after < before, (before, after)
