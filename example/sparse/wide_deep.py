@@ -1,0 +1,86 @@
+"""Wide-and-deep-style training with row_sparse embedding exchange.
+
+Reference: example/sparse/ (wide_deep, matrix_factorization) — the
+pattern where a huge embedding table lives in the kvstore and each step
+only the rows touched by the batch move: `row_sparse_pull` the batch's
+rows, compute, push a RowSparseNDArray gradient back. Memory and wire
+bytes scale with rows-per-batch, not table size (SURVEY hard-part (b)).
+
+Synthetic CTR-style task: each sample has `NNZ` categorical ids out of
+`VOCAB` plus a dense feature vector; label = whether the sum of the true
+(hidden) id weights is positive.
+"""
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=10000)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--nnz", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-batches", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--kv-store", default="local")
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(args.vocab).astype("float32")
+
+    # embedding table lives in the kvstore; dense tower is a local param
+    kv = mx.kv.create(args.kv_store)
+    kv.init("embed", nd.array(
+        rng.randn(args.vocab, args.dim).astype("float32") * 0.05))
+    dense_w = nd.array(rng.randn(args.dim).astype("float32") * 0.1)
+    dense_w.attach_grad()
+
+    correct = total = 0
+    for step in range(args.num_batches):
+        ids = rng.randint(0, args.vocab,
+                          (args.batch_size, args.nnz)).astype("int32")
+        y = (true_w[ids].sum(1) > 0).astype("float32")
+
+        uniq, inv = np.unique(ids, return_inverse=True)
+        # pull ONLY the touched rows (never the vocab-sized table)
+        rows = RowSparseNDArray(nd.zeros((len(uniq), args.dim)),
+                                nd.array(uniq),
+                                (args.vocab, args.dim))
+        kv.row_sparse_pull("embed", out=rows,
+                           row_ids=nd.array(uniq))
+        emb = rows.data  # (n_uniq, dim)
+        emb.attach_grad()
+
+        with autograd.record():
+            gathered = nd.take(emb, nd.array(
+                inv.reshape(args.batch_size, args.nnz).astype("float32")))
+            pooled = nd.sum(gathered, axis=1)       # (B, dim)
+            logit = nd.sum(pooled * dense_w.reshape((1, -1)), axis=1)
+            loss = nd.mean(nd.log(1 + nd.exp(-(
+                (nd.array(y) * 2 - 1) * logit))))
+        loss.backward()
+
+        pred = (logit.asnumpy() > 0).astype("float32")
+        correct += (pred == y).sum()
+        total += len(y)
+
+        # push the sparse embedding gradient: rows touched only
+        kv.push("embed", RowSparseNDArray(
+            nd.array(-args.lr * emb.grad.asnumpy()
+                     + np.asarray(rows.data._data)),
+            nd.array(uniq), (args.vocab, args.dim)))
+        dense_w -= args.lr * dense_w.grad
+        dense_w.grad[:] = 0
+
+        if (step + 1) % 20 == 0:
+            print("step %d: accuracy %.3f" % (step + 1, correct / total))
+            correct = total = 0
+
+
+if __name__ == "__main__":
+    main()

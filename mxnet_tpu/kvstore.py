@@ -86,11 +86,15 @@ class KVStore:
         return merged
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
             vals = v if isinstance(v, (list, tuple)) else [v]
+            if all(isinstance(a, RowSparseNDArray) for a in vals):
+                self._push_row_sparse(k, vals)
+                continue
             if self._compression is not None and "dist" not in self.type \
                     and self._compression.active_for(vals[0]._data):
                 # 'device' store: each device's addend is compressed before
@@ -114,6 +118,40 @@ class KVStore:
                 # kvstore_local PushImpl copies the reduce result)
                 self._data[k]._data = merged
 
+    def _push_row_sparse(self, k, vals):
+        """Row-sparse push: only (indices, values) travel — never the
+        dense table (reference: kvstore_dist_server.h DataHandleRowSparse,
+        comm.h sparse reduce). Duplicate rows scatter-add."""
+        idx = jnp.concatenate([a.indices._data.astype(jnp.int32)
+                               for a in vals])
+        val = jnp.concatenate([a.data._data for a in vals])
+        shape = vals[0].shape
+        idx, val = self._after_merge_sparse(k, idx, val, shape)
+        tgt = self._data[k]
+        n = tgt._data.shape[0]
+        safe = jnp.clip(idx, 0, n - 1)
+        mask = (idx < n)
+        vmask = mask.reshape((-1,) + (1,) * (val.ndim - 1))
+        if self._updater is not None:
+            # local densify of the GRADIENT only (the wire and the pull
+            # path stay sparse); the optimizer update is full-table, like
+            # the reference server's dense fallback for non-lazy updates
+            grad = jnp.zeros(tgt._data.shape, val.dtype).at[safe].add(
+                jnp.where(vmask, val, 0))
+            self._updater(_updater_key(k), NDArray(grad), tgt)
+        else:
+            summed = jnp.zeros(tgt._data.shape, val.dtype).at[safe].add(
+                jnp.where(vmask, val, 0))
+            touched = jnp.zeros((n,), bool).at[safe].set(mask)
+            tshape = touched.reshape((-1,) + (1,) * (tgt._data.ndim - 1))
+            tgt._data = jnp.where(tshape, summed.astype(tgt._data.dtype),
+                                  tgt._data)
+
+    def _after_merge_sparse(self, key, idx, val, shape):
+        """Hook for the cross-process sparse exchange; DistKVStore
+        all-gathers the (indices, values) pairs only."""
+        return idx, val
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)
         for k, o in zip(keys, outs):
@@ -125,9 +163,12 @@ class KVStore:
                 t._data = src
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (reference: kvstore.py:312).
-        TPU note: implemented as a gather; the result is a dense slab of
-        the requested rows written into `out` (row_sparse facade)."""
+        """Pull only the rows in row_ids (reference: kvstore.py:312,
+        kvstore_dist.h:262 pulls just the requested rows). A
+        RowSparseNDArray `out` receives exactly the gathered rows —
+        memory scales with rows touched, not table size; a dense `out`
+        keeps the legacy dense-slab facade."""
+        from .ndarray.sparse import RowSparseNDArray
         if row_ids is None:
             return self.pull(key, out=out, priority=priority)
         keys, outs = _key_value(key, out)
@@ -139,7 +180,13 @@ class KVStore:
             rids = rid._data.astype(jnp.int32)
             rows = jnp.take(src, rids, axis=0)
             for t in targets:
-                t._data = jnp.zeros_like(src).at[rids].set(rows)
+                if isinstance(t, RowSparseNDArray):
+                    t._indices._data = rids
+                    t._values._data = rows
+                    t._data = rows
+                    t._dense_shape = tuple(src.shape)
+                else:
+                    t._data = jnp.zeros_like(src).at[rids].set(rows)
 
     # -- optimizer plumbing --------------------------------------------
     def set_updater(self, updater):

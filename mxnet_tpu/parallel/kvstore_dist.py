@@ -187,6 +187,39 @@ class DistKVStore(KVStore):
             cache[sig] = jax.jit(gather_dequant_sum, out_shardings=rep)
         return cache[sig]
 
+    def _after_merge_sparse(self, key, idx, val, shape):
+        """Cross-process row-sparse exchange: all-gather ONLY the
+        (indices, values) pairs — fixed capacity per process, padding
+        rows marked idx == num_rows (the scatter-nowhere convention).
+        Wire bytes scale with rows touched, never with table size
+        (reference: kvstore_dist.h row_sparse ZPush/ZPull).
+
+        Requires every process to push the same number of rows per key
+        (true for uniform-batch data parallelism); pad locally with
+        idx=num_rows rows to even out if needed."""
+        if self._nproc <= 1:
+            return idx, val
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._proc_mesh()
+        self.last_wire_bytes = int(idx.size) * 4 + int(val.size) * 4
+        sharding_i = NamedSharding(mesh, PartitionSpec("proc"))
+        mine = [d for d in mesh.devices.flat
+                if d.process_index == jax.process_index()][0]
+        gi = jax.make_array_from_single_device_arrays(
+            (self._nproc,) + idx.shape, sharding_i,
+            [jax.device_put(idx[None], mine)])
+        gv = jax.make_array_from_single_device_arrays(
+            (self._nproc,) + val.shape, sharding_i,
+            [jax.device_put(val[None], mine)])
+        rep = NamedSharding(mesh, PartitionSpec())
+        flat = jax.jit(
+            lambda i, v: (i.reshape((-1,)),
+                          v.reshape((-1,) + v.shape[2:])),
+            out_shardings=(rep, rep))
+        oi, ov = flat(gi, gv)
+        return (jnp.asarray(oi.addressable_data(0)),
+                jnp.asarray(ov.addressable_data(0)))
+
     def barrier(self):
         """Global barrier (reference: kvstore.py Barrier → ps-lite)."""
         if self._nproc > 1:

@@ -108,6 +108,31 @@ def main():
     assert kvc.last_wire_bytes * 16 <= dense_bytes + 64, \
         (kvc.last_wire_bytes, dense_bytes)
 
+    # ---- row_sparse push/pull WITHOUT densify -----------------------
+    # (reference: kvstore_dist.h:262 / kvstore_dist_server.h
+    # DataHandleRowSparse). Each worker pushes 2 rows of a 64-row table;
+    # only (indices, values) cross the wire; pull gathers rows into a
+    # RowSparseNDArray whose storage is 2 rows, not 64.
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    kvs = mx.kv.create("dist_sync")  # fresh store: no updater attached
+    T, D = 64, 3
+    kvs.init("rsp", mx.nd.zeros((T, D)))
+    my_rows = np.array([rank, (rank + 17) % T], "int32")
+    vals = np.full((2, D), float(rank + 1), "float32")
+    g = RowSparseNDArray(mx.nd.array(vals), mx.nd.array(my_rows), (T, D))
+    kvs.push("rsp", g)
+    sout = RowSparseNDArray(mx.nd.zeros((2, D)),
+                            mx.nd.array(np.array([0, 0], "i")), (T, D))
+    kvs.row_sparse_pull("rsp", out=sout, row_ids=mx.nd.array(my_rows))
+    assert sout.data.shape == (2, D), sout.data.shape  # rows, not table
+    expect0 = sum(r + 1 for r in range(nw)
+                  if rank in (r % T, (r + 17) % T))
+    got0 = np.asarray(sout.data._data)[0]
+    assert np.allclose(got0, expect0), (rank, got0, expect0)
+    # wire carried 2 rows (idx+val), not the table
+    assert kvs.last_wire_bytes <= 2 * (4 + D * 4) + 64, kvs.last_wire_bytes
+    assert kvs.last_wire_bytes < T * D * 4
+
     kv.barrier()
     print("WORKER_%d_OK" % rank)
 

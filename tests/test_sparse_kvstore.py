@@ -1,0 +1,86 @@
+"""Row-sparse kvstore push/pull without densification.
+
+Reference: kvstore_dist.h:262 (pull only requested rows),
+kvstore_dist_server.h DataHandleRowSparse (scatter-add of pushed rows).
+Pins: sparse pull returns exactly the gathered rows (memory ~ rows
+touched), sparse push touches only pushed rows, duplicate rows add.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray, row_sparse_array
+
+
+def _rsp(indices, values, shape):
+    return RowSparseNDArray(nd.array(np.asarray(values, "float32")),
+                            nd.array(np.asarray(indices, "int32")),
+                            shape)
+
+
+def test_sparse_pull_returns_rows_only():
+    kv = mx.kv.create("local")
+    table = np.arange(40, dtype="float32").reshape(8, 5)
+    kv.init("emb", nd.array(table))
+    out = _rsp([0, 0], np.zeros((2, 5)), (8, 5))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(
+        np.array([2, 5], "int32")))
+    assert out.stype == "row_sparse"
+    assert out.data.shape == (2, 5)  # rows touched, not the 8-row table
+    np.testing.assert_allclose(np.asarray(out.data._data), table[[2, 5]])
+    np.testing.assert_allclose(np.asarray(out.indices._data), [2, 5])
+    # densified view still correct
+    dense = out.asnumpy()
+    assert dense.shape == (8, 5)
+    np.testing.assert_allclose(dense[[2, 5]], table[[2, 5]])
+    assert (dense[[0, 1, 3, 4, 6, 7]] == 0).all()
+
+
+def test_sparse_push_touches_only_pushed_rows():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(np.ones((6, 3), "float32")))
+    g = _rsp([1, 4], [[1., 1., 1.], [2., 2., 2.]], (6, 3))
+    kv.push("emb", g)
+    out = nd.zeros((6, 3))
+    kv.pull("emb", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], [1., 1., 1.])
+    np.testing.assert_allclose(got[4], [2., 2., 2.])
+    np.testing.assert_allclose(got[[0, 2, 3, 5]], 1.0)  # untouched
+
+
+def test_sparse_push_duplicate_rows_add():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((4, 2)))
+    g1 = _rsp([2], [[1., 2.]], (4, 2))
+    g2 = _rsp([2], [[10., 20.]], (4, 2))
+    kv.push("emb", [g1, g2])  # two device addends, same row
+    out = nd.zeros((4, 2))
+    kv.pull("emb", out=out)
+    np.testing.assert_allclose(out.asnumpy()[2], [11., 22.])
+
+
+def test_sparse_push_with_updater_applies_sgd():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.ones((4, 2)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    g = _rsp([1], [[2., 4.]], (4, 2))
+    kv.push("emb", g)
+    out = nd.zeros((4, 2))
+    kv.pull("emb", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], [0., -1.])  # 1 - 0.5*grad
+    np.testing.assert_allclose(got[[0, 2, 3]], 1.0)
+
+
+def test_padding_rows_are_ignored():
+    # idx == num_rows marks padding (fixed-capacity convention)
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((3, 2)))
+    g = _rsp([1, 3], [[5., 5.], [9., 9.]], (3, 2))  # row 3 = padding
+    kv.push("emb", g)
+    out = nd.zeros((3, 2))
+    kv.pull("emb", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], [5., 5.])
+    np.testing.assert_allclose(got[[0, 2]], 0.0)
