@@ -25,13 +25,57 @@ __all__ = ["quantize_model", "quantize_params"]
 _DEFAULT_QUANTIZED_OPS = ("FullyConnected", "Convolution")
 
 
+def _optimal_threshold_kl(hist, edges, num_quantized_bins=255):
+    """Entropy calibration: pick the clip threshold minimizing the KL
+    divergence between the fp32 distribution and its int8-quantized
+    rendering (reference: contrib/quantization.py _get_optimal_threshold
+    / _smooth_distribution)."""
+    hist = hist.astype(np.float64)
+    n = len(hist)
+    thresholds = []
+    divergences = []
+    # candidate thresholds: growing symmetric windows
+    for i in range(num_quantized_bins // 2, n + 1, max(n // 64, 1)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()        # outliers clip into the edge
+        if p.sum() == 0:
+            continue
+        # quantize the window into num_quantized_bins buckets, then
+        # expand back: the Q distribution
+        idx = (np.arange(i) * num_quantized_bins // i)
+        q = np.zeros(i)
+        sums = np.zeros(num_quantized_bins)
+        cnts = np.zeros(num_quantized_bins)
+        np.add.at(sums, idx, p)
+        np.add.at(cnts, idx, (hist[:i] > 0).astype(np.float64))
+        nonzero = hist[:i] > 0
+        expand = np.where(cnts[idx] > 0, sums[idx] /
+                          np.maximum(cnts[idx], 1), 0.0)
+        q[nonzero] = expand[nonzero]
+        pp = p / p.sum()
+        if q.sum() == 0:
+            continue
+        qq = q / q.sum()
+        mask = pp > 0
+        kl = np.sum(pp[mask] * np.log(pp[mask] /
+                                      np.maximum(qq[mask], 1e-12)))
+        thresholds.append(edges[i])
+        divergences.append(kl)
+    if not thresholds:
+        return float(edges[-1])
+    return float(thresholds[int(np.argmin(divergences))])
+
+
 def _collect_ranges(symbol, arg_params, aux_params, calib_data,
-                    num_calib_examples, data_names, label_names):
-    """Run calibration batches, recording min/max of every internal
-    output (calib_mode='naive'; reference: _LayerOutputMinMaxCollector).
+                    num_calib_examples, data_names, label_names,
+                    mode="naive", num_bins=2048):
+    """Run calibration batches, recording min/max (calib_mode='naive')
+    or |activation| histograms for KL thresholds (calib_mode='entropy');
+    reference: _LayerOutputMinMaxCollector / _LayerOutputCollector.
     """
     internals = symbol.get_internals()
     ranges = {}
+    hists = {}
     n_seen = 0
     ex = None
     calib_data.reset()
@@ -69,10 +113,29 @@ def _collect_ranges(symbol, arg_params, aux_params, calib_data,
                                 max(ranges[name][1], mx))
             else:
                 ranges[name] = (mn, mx)
+            if mode == "entropy":
+                prev = hists.get(name)
+                if prev is None:
+                    amax = max(abs(mn), abs(mx), 1e-12)
+                    edges = np.linspace(0, amax, num_bins + 1)
+                    hists[name] = (np.histogram(np.abs(a),
+                                                bins=edges)[0], edges)
+                else:
+                    # later batches re-bin into the first batch's edges;
+                    # overflow clips into the last bin (KL calibration
+                    # clips outliers anyway)
+                    h0, edges = prev
+                    h = np.histogram(np.clip(np.abs(a), 0, edges[-1]),
+                                     bins=edges)[0]
+                    hists[name] = (h0 + h, edges)
         n_seen += batch.data[0].shape[0]
         if num_calib_examples is not None and \
                 n_seen >= num_calib_examples:
             break
+    if mode == "entropy":
+        for name, (h, edges) in hists.items():
+            thr = _optimal_threshold_kl(h, edges[1:])
+            ranges[name] = (-thr, thr)
     return ranges
 
 
@@ -129,6 +192,57 @@ def _rewrite_qdq(symbol, ranges, quantized_dtype, excluded_sym_names,
     return Symbol(new_entries)
 
 
+def _rewrite_int8(symbol, ranges, excluded_sym_names, quantize_ops):
+    """Lower Convolution/FullyConnected to real int8 compute
+    (_contrib_int8_conv/_contrib_int8_fc sandwiches): the data input
+    quantizes by the calibrated amax, the weight by its own max, the
+    int32 accumulator rescales to fp32 — the reference's
+    quantize_graph_pass flow collapsed into one op per layer."""
+    from ..graph import Node
+    from ..ops import registry as _reg
+
+    memo = {}
+
+    def amax_of(inode):
+        for key in ((inode.name,) if inode.is_variable
+                    else (inode.name + "_output", inode.name)):
+            if key in ranges:
+                mn, mx = ranges[key]
+                return max(abs(mn), abs(mx), 1e-12)
+        return None
+
+    lowered = {"Convolution": "_contrib_int8_conv",
+               "FullyConnected": "_contrib_int8_fc"}
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            memo[id(node)] = node
+            return node
+        new_inputs = [(clone(i), idx) for (i, idx) in node.inputs]
+        opname = node.op.name if node.op is not None else None
+        if opname in lowered and opname in quantize_ops and \
+                node.name not in excluded_sym_names:
+            amax = amax_of(node.inputs[0][0])
+            if amax is not None:
+                params = dict(node.params)
+                params["amax_data"] = float(amax)
+                nn_node = Node(_reg.get(lowered[opname]), new_inputs,
+                               params, node.name,
+                               is_aux=node.is_aux,
+                               attrs=dict(node.attrs or {}))
+                memo[id(node)] = nn_node
+                return nn_node
+        nn_node = Node(node.op, new_inputs, dict(node.params), node.name,
+                       is_aux=node.is_aux, attrs=dict(node.attrs or {}))
+        memo[id(node)] = nn_node
+        return nn_node
+
+    new_entries = [(clone(n), i) for (n, i) in symbol._entries]
+    return Symbol(new_entries)
+
+
 def quantize_params(qsym, params):
     """Quantize parameter values whose QDQ amax is 0 (per-tensor
     symmetric) — weights keep fp32 storage with QDQ applied in-graph, so
@@ -142,8 +256,13 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=None, calib_mode="naive",
                    calib_data=None, num_calib_examples=None,
                    quantized_dtype="int8", quantize_ops=None,
-                   logger=None):
+                   quantize_mode="full", logger=None):
     """Quantize a model (reference: contrib/quantization.py:412).
+
+    calib_mode: 'naive' (min/max), 'entropy' (KL-optimal thresholds,
+    reference _get_optimal_threshold), or 'none'.
+    quantize_mode: 'full' lowers Conv/FC to real int8 compute
+    (MXU int8 path); 'qdq' inserts fake-quant pairs only (QAT-style).
 
     Returns (qsym, qarg_params, aux_params)."""
     if quantized_dtype not in ("int8", "uint8"):
@@ -153,15 +272,19 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     if calib_mode == "none" or calib_data is None:
         ranges = {}
-    elif calib_mode == "naive":
+    elif calib_mode in ("naive", "entropy"):
         ranges = _collect_ranges(sym, arg_params, aux_params, calib_data,
                                  num_calib_examples, data_names,
-                                 label_names)
+                                 label_names, mode=calib_mode)
     else:
         raise MXNetError(
-            "calib_mode %r not supported (use 'naive' or 'none')"
-            % calib_mode)
+            "calib_mode %r not supported (use 'naive', 'entropy' or "
+            "'none')" % calib_mode)
 
-    qsym = _rewrite_qdq(sym, ranges, quantized_dtype,
-                        excluded_sym_names, quantize_ops)
+    if quantize_mode == "full" and quantized_dtype == "int8":
+        qsym = _rewrite_int8(sym, ranges, excluded_sym_names,
+                             quantize_ops)
+    else:
+        qsym = _rewrite_qdq(sym, ranges, quantized_dtype,
+                            excluded_sym_names, quantize_ops)
     return qsym, quantize_params(qsym, arg_params), dict(aux_params)

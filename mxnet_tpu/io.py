@@ -20,7 +20,7 @@ from .base import MXNetError
 from .ndarray import NDArray, array as nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter",
+           "ImageRecordIter", "LibSVMIter",
            "ResizeIter", "PrefetchingIter", "MNISTIter"]
 
 
@@ -456,3 +456,84 @@ def __getattr__(name):
         from .io_record import ImageRecordIter
         return ImageRecordIter
     raise AttributeError(name)
+
+
+class LibSVMIter(DataIter):
+    """libsvm-format iterator emitting CSR batches
+    (reference: src/io/iter_libsvm.cc, io.LibSVMIter).
+
+    Lines: ``<label> <idx>:<val> <idx>:<val> ...``; indices 0-based like
+    the reference's default. Labels may themselves be sparse via
+    `label_libsvm`."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self._feat_dim = int(data_shape[0]) if not isinstance(
+            data_shape, int) else int(data_shape)
+        self._rows, self._labels = self._parse(data_libsvm,
+                                               self._feat_dim)
+        if label_libsvm:
+            ldim = int(label_shape[0]) if label_shape else 1
+            lrows, _ = self._parse(label_libsvm, ldim)
+            self._labels = [r.todense().asnumpy() if hasattr(r, "todense")
+                            else r for r in lrows]
+        self._round_batch = round_batch
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size, self._feat_dim))]
+        self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self._cur = 0
+
+    @staticmethod
+    def _parse(path, dim):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                idxs, vals = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idxs.append(int(i))
+                    vals.append(float(v))
+                rows.append((np.asarray(idxs, np.int32),
+                             np.asarray(vals, np.float32)))
+        return rows, labels
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        from .ndarray.sparse import CSRNDArray
+        from .ndarray import array as nd_array
+        n = len(self._rows)
+        if self._cur >= n:
+            raise StopIteration
+        end = self._cur + self.batch_size
+        idx = list(range(self._cur, min(end, n)))
+        pad = 0
+        if end > n:
+            if not self._round_batch or not idx:
+                if len(idx) < self.batch_size:
+                    raise StopIteration
+            pad = end - n
+            idx += idx[-1:] * pad
+        indptr = [0]
+        cols, vals = [], []
+        for i in idx:
+            ci, cv = self._rows[i]
+            cols.extend(ci.tolist())
+            vals.extend(cv.tolist())
+            indptr.append(len(cols))
+        data = CSRNDArray(nd_array(np.asarray(vals, np.float32)),
+                          nd_array(np.asarray(cols, np.int32)),
+                          nd_array(np.asarray(indptr, np.int32)),
+                          (self.batch_size, self._feat_dim))
+        labels = np.asarray([self._labels[i] for i in idx], np.float32)
+        self._cur = end
+        return DataBatch([data], [nd_array(labels)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

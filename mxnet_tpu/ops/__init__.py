@@ -6,6 +6,8 @@ from . import nn          # noqa: F401
 from . import random_ops  # noqa: F401
 from . import init_ops    # noqa: F401
 from . import contrib     # noqa: F401
+from . import vision      # noqa: F401
+from . import extra       # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import quantization as quantization_ops  # noqa: F401
 from . import control_flow  # noqa: F401

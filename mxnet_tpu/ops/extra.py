@@ -1,0 +1,485 @@
+"""Breadth operators: fused optimizer updates, extra samplers, misc
+tensor ops.
+
+Reference surface: src/operator/optimizer_op.cc (sgd/adam/rmsprop/
+ftrl/ftml/signum update ops), random/sample_op.cc (distribution
+samplers), tensor/{histogram, ravel, square_sum, matrix_op} extras,
+image/image_random.cc (to_tensor/normalize), contrib/bounding_box.
+
+TPU-native notes: the fused update ops are single jit-able elementwise
+expressions (XLA fuses the whole update chain); they are functional —
+"mutated" state arrives back via the aux write-back mechanism, the same
+contract BatchNorm's moving stats use (the reference mutates in place).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, tuple_param
+from .registry import register, alias, exists
+
+# ---------------------------------------------------------------------------
+# fused optimizer update ops (reference: optimizer_op.cc). Outputs beyond
+# the first are state writes (aux_write routes them back into the input
+# arrays, mirroring the reference's in-place mutation).
+# ---------------------------------------------------------------------------
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update")
+def _sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", num_outputs=2, visible_outputs=1,
+          aux_write={1: 2})
+def _sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0,
+                    lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom.astype(jnp.float32) - lr * g
+    return ((weight.astype(jnp.float32) + new_mom).astype(weight.dtype),
+            new_mom.astype(mom.dtype))
+
+
+@register("mp_sgd_update", num_outputs=2, visible_outputs=1,
+          aux_write={1: 2})
+def _mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   lazy_update=True):
+    """Mixed-precision SGD: fp32 master copy updated, fp16 weight is the
+    cast (reference: optimizer_op.cc MP_SGD)."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3, visible_outputs=1,
+          aux_write={1: 2, 2: 3})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight32)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("adam_update", num_outputs=3, visible_outputs=1,
+          aux_write={1: 2, 2: 3})
+def _adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * g * g
+    w = weight.astype(jnp.float32) - lr * m / (jnp.sqrt(v) + epsilon)
+    return w.astype(weight.dtype), m.astype(mean.dtype), v.astype(var.dtype)
+
+
+@register("rmsprop_update", num_outputs=2, visible_outputs=1,
+          aux_write={1: 2})
+def _rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * g * g
+    w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), new_n.astype(n.dtype)
+
+
+@register("rmspropalex_update", num_outputs=4, visible_outputs=1,
+          aux_write={1: 2, 2: 3, 3: 4})
+def _rmspropalex_update(weight, grad, n, g_acc, delta, *, lr, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0,
+                        clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * g * g
+    new_g = gamma1 * g_acc + (1 - gamma1) * g
+    new_d = gamma2 * delta - lr * g / jnp.sqrt(new_n - new_g * new_g
+                                               + epsilon)
+    w = weight.astype(jnp.float32) + new_d
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return (w.astype(weight.dtype), new_n.astype(n.dtype),
+            new_g.astype(g_acc.dtype), new_d.astype(delta.dtype))
+
+
+@register("ftrl_update", num_outputs=3, visible_outputs=1,
+          aux_write={1: 2, 2: 3})
+def _ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0,
+                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight.astype(jnp.float32)
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, 0.0,
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w.astype(weight.dtype), new_z.astype(z.dtype), \
+        new_n.astype(n.dtype)
+
+
+@register("ftml_update", num_outputs=4, visible_outputs=1,
+          aux_write={1: 2, 2: 3, 3: 4})
+def _ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad + \
+        wd * weight.astype(jnp.float32)
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * g * g
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * \
+        weight.astype(jnp.float32)
+    w = -new_z / d_t
+    return (w.astype(weight.dtype), d_t.astype(d.dtype),
+            new_v.astype(v.dtype), new_z.astype(z.dtype))
+
+
+@register("signsgd_update")
+def _signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return (weight.astype(jnp.float32) - lr * jnp.sign(g)) \
+        .astype(weight.dtype)
+
+
+@register("signum_update", num_outputs=2, visible_outputs=1,
+          aux_write={1: 2})
+def _signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight.astype(jnp.float32) + \
+        lr * jnp.sign(new_mom)
+    return w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("_sparse_adagrad_update", num_outputs=2, visible_outputs=1,
+          aux_write={1: 2})
+def _sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad update (reference: optimizer_op.cc AdagradUpdate; the
+    row_sparse gradient case reduces to this dense form after the
+    kvstore's sparse exchange)."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_h = history + g * g
+    w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_h) + epsilon)
+    return w.astype(weight.dtype), new_h.astype(history.dtype)
+
+
+# ---------------------------------------------------------------------------
+# distribution samplers (reference: random/sample_op.cc _sample_*):
+# one distribution parameter vector -> `shape` draws per parameter row
+# ---------------------------------------------------------------------------
+
+
+def _sample_shape(param, shape):
+    shape = tuple_param(shape, None) if isinstance(shape, (list, tuple)) \
+        else ((shape,) if isinstance(shape, int) else tuple(shape or ()))
+    return param.shape + tuple(s for s in shape if s != 0)
+
+
+@register("_sample_exponential", needs_rng=True)
+def _sample_exponential(key, lam, *, shape=(), dtype="float32"):
+    out = _sample_shape(lam, shape)
+    lam_b = lam.reshape(lam.shape + (1,) * (len(out) - lam.ndim))
+    return (jax.random.exponential(key, out, jnp.dtype(dtype))
+            / lam_b).astype(jnp.dtype(dtype))
+
+
+@register("_sample_gamma", needs_rng=True)
+def _sample_gamma(key, alpha, beta, *, shape=(), dtype="float32"):
+    out = _sample_shape(alpha, shape)
+    a = alpha.reshape(alpha.shape + (1,) * (len(out) - alpha.ndim))
+    b = beta.reshape(beta.shape + (1,) * (len(out) - beta.ndim))
+    return (jax.random.gamma(key, a * jnp.ones(out, jnp.float32),
+                             dtype=jnp.float32) * b).astype(
+                                 jnp.dtype(dtype))
+
+
+@register("_sample_poisson", needs_rng=True)
+def _sample_poisson(key, lam, *, shape=(), dtype="float32"):
+    out = _sample_shape(lam, shape)
+    lam_b = lam.reshape(lam.shape + (1,) * (len(out) - lam.ndim))
+    return jax.random.poisson(key, lam_b * jnp.ones(out, jnp.float32)
+                              ).astype(jnp.dtype(dtype))
+
+
+@register("_sample_negative_binomial", needs_rng=True)
+def _sample_negative_binomial(key, k, p, *, shape=(), dtype="float32"):
+    """NB(k, p) as a gamma-poisson mixture (reference sampler's
+    definition: number of failures before k successes)."""
+    out = _sample_shape(k, shape)
+    kk = k.reshape(k.shape + (1,) * (len(out) - k.ndim)).astype(jnp.float32)
+    pp = p.reshape(p.shape + (1,) * (len(out) - p.ndim)).astype(jnp.float32)
+    k1, k2 = jax.random.split(key)
+    rate = jax.random.gamma(k1, kk * jnp.ones(out, jnp.float32)) \
+        * (1 - pp) / pp
+    return jax.random.poisson(k2, rate).astype(jnp.dtype(dtype))
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True)
+def _sample_gnb(key, mu, alpha, *, shape=(), dtype="float32"):
+    out = _sample_shape(mu, shape)
+    m = mu.reshape(mu.shape + (1,) * (len(out) - mu.ndim)).astype(
+        jnp.float32)
+    a = alpha.reshape(alpha.shape + (1,) * (len(out) - alpha.ndim)
+                      ).astype(jnp.float32)
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / jnp.maximum(a, 1e-12)
+    rate = jax.random.gamma(k1, r * jnp.ones(out, jnp.float32)) * m * a
+    return jax.random.poisson(k2, rate).astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# misc tensor ops
+# ---------------------------------------------------------------------------
+
+
+@register("add_n", aliases=("ElementWiseSum",) if not
+          exists("ElementWiseSum") else ())
+def _add_n(*args, num_args=0):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    return lhs + rhs
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    """(reference: loss_binary_op.cc): scalar summed CE over the batch."""
+    lp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lbl = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(lp, lbl[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1).astype(data.dtype)
+
+
+@register("_histogram", num_outputs=2)
+def _histogram(data, *bins_in, bin_cnt=None, range=None):
+    if bin_cnt is not None:
+        lo, hi = range
+        cnt, edges = jnp.histogram(data.reshape(-1), bins=int(bin_cnt),
+                                   range=(lo, hi))
+    else:
+        edges_in = bins_in[0]
+        cnt, edges = jnp.histogram(data.reshape(-1), bins=edges_in)
+    return cnt, edges
+
+
+@register("_ravel_multi_index")
+def _ravel_multi_index(data, *, shape):
+    """data (ndim, N) -> flat indices (reference: ravel.cc)."""
+    dims = tuple(int(s) for s in shape)
+    strides = []
+    acc = 1
+    for d in reversed(dims):
+        strides.append(acc)
+        acc *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("_unravel_index")
+def _unravel_index(data, *, shape):
+    dims = tuple(int(s) for s in shape)
+    out = []
+    rem = data.astype(jnp.int32)
+    acc = 1
+    for d in dims:
+        acc *= d
+    for d in dims:
+        acc //= d
+        out.append(rem // acc)
+        rem = rem % acc
+    return jnp.stack(out).astype(data.dtype)
+
+
+def _logical(name, fn):
+    @register(name)
+    def _op(lhs, rhs, _fn=fn):
+        return _fn(lhs != 0, rhs != 0).astype(lhs.dtype)
+
+    @register(name + "_scalar")
+    def _op_scalar(data, *, scalar=0.0, _fn=fn):
+        return _fn(data != 0, scalar != 0).astype(data.dtype)
+
+
+_logical("_logical_and", jnp.logical_and)
+_logical("_logical_or", jnp.logical_or)
+_logical("_logical_xor", jnp.logical_xor)
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, *, begin, end, step=()):
+    idx = tuple(slice(b, e, s or None) for b, e, s in
+                zip(begin, end, step or (None,) * len(begin)))
+    return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(data, *, scalar=0.0, begin=(), end=(), step=()):
+    idx = tuple(slice(b, e, s or None) for b, e, s in
+                zip(begin, end, step or (None,) * len(begin)))
+    return data.at[idx].set(scalar)
+
+
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(data, *, scalar=0.0):
+    return data + scalar
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(data, *, scalar=0.0):
+    return data - scalar
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+@register("_square_sum")
+def _square_sum(data, *, axis=None, keepdims=False, exclude=False):
+    ax = axis if axis is None else tuple_param(axis, None) \
+        if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("_image_to_tensor", aliases=("_npi_to_tensor",))
+def _image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference:
+    image/image_random.cc ToTensor); batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return x.transpose(2, 0, 1)
+    return x.transpose(0, 3, 1, 2)
+
+
+@register("_image_normalize")
+def _image_normalize(data, *, mean=(0, 0, 0), std=(1, 1, 1)):
+    """CHW normalize (reference: image_random.cc Normalize)."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    shape = (-1,) + (1,) * (data.ndim - 1 - (1 if data.ndim == 4 else 0))
+    if data.ndim == 4:
+        return (data - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
+    return (data - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2)
+def _bipartite_matching(data, *, is_ascend=False, threshold=0.0,
+                        topk=-1):
+    """Greedy bipartite matching over a score matrix (reference:
+    contrib/bounding_box.cc BipartiteMatching). Returns (row->col
+    match or -1, col->row match or -1). Fixed-trip lax.fori_loop."""
+    rows, cols = data.shape[-2], data.shape[-1]
+    k = min(rows, cols) if topk <= 0 else min(topk, min(rows, cols))
+    sign = 1.0 if not is_ascend else -1.0
+
+    def one(mat):
+        m = mat * sign
+
+        def body(_, state):
+            m_cur, rmatch, cmatch = state
+            flat = jnp.argmax(m_cur)
+            i, j = flat // cols, flat % cols
+            ok = m_cur[i, j] > (threshold * sign if not is_ascend
+                                else -jnp.inf)
+            rmatch = jnp.where(ok, rmatch.at[i].set(j), rmatch)
+            cmatch = jnp.where(ok, cmatch.at[j].set(i), cmatch)
+            m_cur = m_cur.at[i, :].set(-jnp.inf)
+            m_cur = m_cur.at[:, j].set(-jnp.inf)
+            return m_cur, rmatch, cmatch
+
+        init = (m, jnp.full((rows,), -1, jnp.float32),
+                jnp.full((cols,), -1, jnp.float32))
+        _, rmatch, cmatch = lax.fori_loop(0, k, body, init)
+        return rmatch, cmatch
+
+    if data.ndim == 2:
+        return one(data)
+    r, c = jax.vmap(one)(data)
+    return r, c
+
+
+@register("_contrib_SparseEmbedding")
+def _sparse_embedding(data, weight, *, input_dim, output_dim,
+                      dtype="float32", sparse_grad=True):
+    """Embedding whose gradient is row-sparse in the reference
+    (contrib SparseEmbedding); the gather itself is identical — the
+    sparse gradient exchange happens in the kvstore layer here."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("cast_storage")
+def _cast_storage_op(data, *, stype="default"):
+    """Storage cast (reference: cast_storage.cc). The dense array is the
+    canonical XLA form; dense->dense is identity here, sparse conversion
+    happens at the NDArray layer (ndarray/sparse.py cast_storage)."""
+    return data
+
+
+@register("_sparse_retain")
+def _sparse_retain_op(data, indices):
+    """Keep only the given rows (reference: sparse_retain.cc). Dense
+    form: rows not in `indices` zero out; the RowSparseNDArray layer
+    (ndarray/sparse.py retain) handles the sparse storage case."""
+    n = data.shape[0]
+    keep = jnp.zeros((n,), bool).at[
+        jnp.clip(indices.astype(jnp.int32), 0, n - 1)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(data):
+    """Device copy (reference: cross_device_copy.cc). XLA/PJRT moves
+    buffers on demand; under jit this is the identity."""
+    return data
+
+
+# legacy/front-end alias names kept for reference compatibility
+from .registry import alias as _alias  # noqa: E402
+
+for _old, _new in [
+        ("Convolution", "Convolution_v1"),   # v1 = pre-NNVM property op
+        ("Pooling", "Pooling_v1"),
+        ("slice", "crop"),
+]:
+    if exists(_old) and not exists(_new):
+        _alias(_old, _new)

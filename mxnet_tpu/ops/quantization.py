@@ -107,3 +107,136 @@ def _qdq(data, *, amax=0.0, signed=True):
         q = jnp.round(jnp.clip(x * scale, 0, 255)) / scale
     # straight-through gradient
     return data + lax.stop_gradient(q - x).astype(data.dtype)
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def _quantized_conv(data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+                    *, kernel, num_filter, stride=None, dilate=None,
+                    pad=None, num_group=1, no_bias=True, layout=None,
+                    cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """int8 x int8 -> int32 convolution
+    (reference: quantization/quantized_conv.cc). Same geometry as
+    Convolution; accumulates int32 so the product is exact, then carries
+    the combined scale in the min/max outputs."""
+    from .nn import _conv_dim_numbers
+    from ..base import tuple_param
+    x = data
+    nd_ = len(kernel)
+    stride = tuple_param(stride, nd_) or (1,) * nd_
+    dilate = tuple_param(dilate, nd_) or (1,) * nd_
+    pad = tuple_param(pad, nd_) or (0,) * nd_
+    lhs, rhs, out = _conv_dim_numbers(nd_, layout)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs, out))
+    acc = lax.conv_general_dilated(
+        x.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax))
+    w_amax = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax))
+    out_scale = (d_amax / _INT8_RANGE) * (w_amax / _INT8_RANGE)
+    if not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bmin), jnp.abs(bmax))
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_RANGE)
+        b_int = jnp.round(b_real / jnp.maximum(out_scale, 1e-20)
+                          ).astype(jnp.int32)
+        c_axis = lhs.index("C")
+        shape = [1] * acc.ndim
+        shape[c_axis] = b_int.size
+        acc = acc + b_int.reshape(shape)
+    amax_out = out_scale * (2.0 ** 31 - 1)
+    return acc, -amax_out, amax_out
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(data, dmin, dmax, *, kernel=(), pool_type="max",
+                       stride=None, pad=None, global_pool=False,
+                       pooling_convention="valid", layout=None,
+                       count_include_pad=True, cudnn_off=False, p_value=2):
+    """int8 pooling (reference: quantization/quantized_pooling.cc):
+    pool in the integer domain, ranges pass through unchanged."""
+    from .nn import _pooling
+    y = _pooling(data.astype(jnp.float32), kernel=kernel,
+                 pool_type=pool_type, stride=stride, pad=pad,
+                 global_pool=global_pool,
+                 pooling_convention=pooling_convention, layout=layout,
+                 count_include_pad=count_include_pad, p_value=p_value)
+    if pool_type == "max":
+        y = y.astype(data.dtype)  # exact for int inputs
+    else:
+        y = jnp.clip(jnp.round(y), -127, 127).astype(data.dtype)
+    return y, dmin, dmax
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, dmin, dmax):
+    """(reference: quantization/quantized_flatten.cc)."""
+    return data.reshape(data.shape[0], -1), dmin, dmax
+
+
+@register("_contrib_quantized_act", num_outputs=3)
+def _quantized_act(data, dmin, dmax, *, act_type="relu"):
+    """int8 activation (reference: mkldnn quantized_act): relu in the
+    integer domain keeps the range's positive half."""
+    if act_type != "relu":
+        raise ValueError("quantized_act: only relu")
+    return jnp.maximum(data, 0), jnp.zeros_like(dmin), dmax
+
+
+@register("_contrib_int8_conv")
+def _int8_conv(data, weight, *rest, amax_data, kernel, num_filter,
+               stride=None, dilate=None, pad=None, num_group=1,
+               no_bias=True, layout=None, cudnn_tune=None,
+               cudnn_off=False, workspace=1024):
+    """Self-contained int8 conv 'sandwich' (quantize -> int8 conv ->
+    dequantize): data quantizes by the calibrated amax, the weight by
+    its own max (per-tensor symmetric), the int32 accumulator rescales
+    back to fp32. The int8 conv rides the MXU's int8 path (reference
+    flow: quantize.cc + quantized_conv.cc + dequantize.cc fused)."""
+    from .nn import _conv_dim_numbers
+    from ..base import tuple_param
+    x = data.astype(jnp.float32)
+    w = weight.astype(jnp.float32)
+    sd = jnp.float32(amax_data) / _INT8_RANGE
+    sw = jnp.maximum(jnp.max(jnp.abs(w)), 1e-12) / _INT8_RANGE
+    qd = jnp.clip(jnp.round(x / sd), -127, 127).astype(jnp.int8)
+    qw = jnp.clip(jnp.round(w / sw), -127, 127).astype(jnp.int8)
+    nd_ = len(kernel)
+    stride = tuple_param(stride, nd_) or (1,) * nd_
+    dilate = tuple_param(dilate, nd_) or (1,) * nd_
+    pad = tuple_param(pad, nd_) or (0,) * nd_
+    lhs, rhs, out = _conv_dim_numbers(nd_, layout)
+    dn = lax.conv_dimension_numbers(qd.shape, qw.shape, (lhs, rhs, out))
+    acc = lax.conv_general_dilated(
+        qd.astype(jnp.int32), qw.astype(jnp.int32), window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (sd * sw)
+    if not no_bias and rest:
+        c_axis = lhs.index("C")
+        shape = [1] * y.ndim
+        shape[c_axis] = rest[0].size
+        y = y + rest[0].astype(jnp.float32).reshape(shape)
+    return y.astype(data.dtype)
+
+
+@register("_contrib_int8_fc")
+def _int8_fc(data, weight, *rest, amax_data, num_hidden, no_bias=False,
+             flatten=True):
+    """int8 FullyConnected sandwich (see _contrib_int8_conv)."""
+    x = data.astype(jnp.float32)
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    w = weight.astype(jnp.float32)
+    sd = jnp.float32(amax_data) / _INT8_RANGE
+    sw = jnp.maximum(jnp.max(jnp.abs(w)), 1e-12) / _INT8_RANGE
+    qd = jnp.clip(jnp.round(x / sd), -127, 127).astype(jnp.int32)
+    qw = jnp.clip(jnp.round(w / sw), -127, 127).astype(jnp.int32)
+    acc = lax.dot_general(qd, qw, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (sd * sw)
+    if not no_bias and rest:
+        y = y + rest[0].astype(jnp.float32)
+    return y.astype(data.dtype)

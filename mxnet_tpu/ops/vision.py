@@ -1,0 +1,531 @@
+"""Vision/detection operators: the spatial-transform and region family.
+
+Reference surface: src/operator/spatial_transformer.cc,
+grid_generator-inl.h, bilinear_sampler.cc, crop-inl.h, roi_pooling.cc,
+svm_output.cc, contrib/{deformable_convolution, psroi_pooling,
+deformable_psroi_pooling, proposal, multi_proposal, sync_batch_norm}.
+
+TPU-native notes: everything here is expressed as gathers, masked
+reductions and dense contractions — the shapes are static, so XLA tiles
+them; bilinear sampling is a 4-corner gather + weighted sum that
+differentiates through both data and coordinates; NMS is a
+fixed-trip-count lax.fori_loop (static post-NMS K), not data-dependent
+Python control flow.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, tuple_param
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# grid generation + bilinear sampling (spatial transformer networks)
+# ---------------------------------------------------------------------------
+
+
+def _affine_grid(theta, h, w):
+    """theta (N, 6) -> sampling grid (N, 2, h, w), xy order, in [-1, 1]
+    (reference: grid_generator-inl.h affine path)."""
+    n = theta.shape[0]
+    xs = jnp.linspace(-1.0, 1.0, w)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    gx, gy = jnp.meshgrid(xs, ys)          # (h, w)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones]).reshape(3, -1)   # (3, h*w)
+    out = theta.reshape(n, 2, 3).astype(jnp.float32) @ base  # (N, 2, h*w)
+    return out.reshape(n, 2, h, w)
+
+
+@register("GridGenerator")
+def _grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Generate sampling grids (reference: grid_generator-inl.h)."""
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        return _affine_grid(data, h, w).astype(data.dtype)
+    if transform_type == "warp":
+        # data: (N, 2, H, W) flow field added to the identity grid, then
+        # normalized to [-1, 1]
+        n, _, fh, fw = data.shape
+        gx, gy = jnp.meshgrid(jnp.arange(fw, dtype=data.dtype),
+                              jnp.arange(fh, dtype=data.dtype))
+        x = (data[:, 0] + gx) * (2.0 / jnp.maximum(fw - 1, 1)) - 1.0
+        y = (data[:, 1] + gy) * (2.0 / jnp.maximum(fh - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise MXNetError("GridGenerator: unknown transform_type %r"
+                     % transform_type)
+
+
+def _bilinear_sample_one(img, gx, gy):
+    """img (C, H, W); gx, gy (...,) pixel coords. Zero padding outside.
+    Differentiable in img AND coordinates."""
+    H, W = img.shape[1], img.shape[2]
+    x0f = jnp.floor(gx)
+    y0f = jnp.floor(gy)
+    x0 = x0f.astype(jnp.int32)
+    y0 = y0f.astype(jnp.int32)
+    wx = (gx - x0f).astype(img.dtype)
+    wy = (gy - y0f).astype(img.dtype)
+
+    def at(yy, xx):
+        valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1)
+        xc = jnp.clip(xx, 0, W - 1)
+        v = img[:, yc, xc]                 # (C, ...)
+        return v * valid.astype(img.dtype)
+
+    return (at(y0, x0) * (1 - wy) * (1 - wx)
+            + at(y0, x0 + 1) * (1 - wy) * wx
+            + at(y0 + 1, x0) * wy * (1 - wx)
+            + at(y0 + 1, x0 + 1) * wy * wx)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, *, cudnn_off=False):
+    """Sample data at grid locations (reference: bilinear_sampler.cc).
+    data (N,C,H,W); grid (N,2,Ho,Wo), xy in [-1,1]; zero outside."""
+    H, W = data.shape[2], data.shape[3]
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0    # (N, Ho, Wo)
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return jax.vmap(_bilinear_sample_one)(data, gx, gy)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, *, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    """STN: affine grid from loc + bilinear sampling
+    (reference: spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer: only affine/bilinear")
+    h, w = int(target_shape[0]), int(target_shape[1])
+    grid = _affine_grid(loc, h, w)
+    return _bilinear_sampler(data, grid.astype(data.dtype))
+
+
+@register("Crop")
+def _crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False,
+          num_args=1):
+    """Spatial crop (reference: crop-inl.h). With two inputs, crops data
+    to crop_like's spatial shape."""
+    x = data[0]
+    if len(data) > 1:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = x.shape[2], x.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return x[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling family
+# ---------------------------------------------------------------------------
+
+
+def _bin_masks(starts, ends, size):
+    """(P,) bin starts/ends -> (P, size) membership masks."""
+    r = jnp.arange(size)
+    return (r[None, :] >= starts[:, None]) & (r[None, :] < ends[:, None])
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, *, pooled_size, spatial_scale):
+    """Max pooling over ROI bins (reference: roi_pooling.cc). rois
+    (R, 5) = [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = tuple_param(pooled_size, 2)
+    H, W = data.shape[2], data.shape[3]
+
+    def one(roi):
+        img = data[roi[0].astype(jnp.int32)]          # (C, H, W)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bh, bw = rh / ph, rw / pw
+        i = jnp.arange(ph, dtype=data.dtype)
+        j = jnp.arange(pw, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(i * bh) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((i + 1) * bh) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(j * bw) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((j + 1) * bw) + x1, 0, W)
+        mh = _bin_masks(hstart, hend, H)               # (ph, H)
+        mw = _bin_masks(wstart, wend, W)               # (pw, W)
+        mask = mh[:, None, :, None] & mw[None, :, None, :]  # (ph,pw,H,W)
+        vals = jnp.where(mask[None], img[:, None, None, :, :],
+                         -jnp.inf)
+        out = vals.max(axis=(3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(data.dtype)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_PSROIPooling")
+def _psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                   group_size=0):
+    """Position-sensitive ROI average pooling (reference:
+    contrib/psroi_pooling.cc). Channel c of bin (i,j) pools input
+    channel (c*g + i)*g + j."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    H, W = data.shape[2], data.shape[3]
+    output_dim = int(output_dim)
+
+    def one(roi):
+        img = data[roi[0].astype(jnp.int32)]
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / p, rw / p
+        i = jnp.arange(p, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(i * bh + y1), 0, H)
+        hend = jnp.clip(jnp.ceil((i + 1) * bh + y1), 0, H)
+        wstart = jnp.clip(jnp.floor(i * bw + x1), 0, W)
+        wend = jnp.clip(jnp.ceil((i + 1) * bw + x1), 0, W)
+        mh = _bin_masks(hstart, hend, H).astype(data.dtype)   # (p, H)
+        mw = _bin_masks(wstart, wend, W).astype(data.dtype)   # (p, W)
+        # per-bin sums for ALL channels: (C, p, p)
+        sums = jnp.einsum("chw,ih,jw->cij", img, mh, mw)
+        cnt = jnp.maximum(jnp.einsum("ih,jw->ij", mh, mw), 1.0)
+        avg = sums / cnt[None]
+        # position-sensitive channel selection:
+        # out[c, i, j] = avg[(c*g + gi)*g + gj, i, j]
+        c_out = jnp.arange(output_dim)
+        i_idx = jnp.arange(p)
+        gi = jnp.clip((i_idx * g) // p, 0, g - 1)
+        cmap = ((c_out[:, None, None] * g + gi[None, :, None]) * g
+                + gi[None, None, :])                   # (out, p, p)
+        return avg[cmap, i_idx[None, :, None], i_idx[None, None, :]]
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=1)
+def _deformable_psroi_pooling(data, rois, *trans_opt, spatial_scale,
+                              output_dim, group_size, pooled_size,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False):
+    """Deformable PSROI pooling (reference:
+    contrib/deformable_psroi_pooling.cc). Bins sample `sample_per_part`^2
+    bilinear points, optionally shifted by learned offsets `trans`."""
+    p = int(pooled_size)
+    g = int(group_size)
+    part = int(part_size) or p
+    sp = max(int(sample_per_part), 1)
+    H, W = data.shape[2], data.shape[3]
+    output_dim = int(output_dim)
+    trans = None if (no_trans or not trans_opt) else trans_opt[0]
+
+    def one(roi, r_idx):
+        img = data[roi[0].astype(jnp.int32)]
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        i = jnp.arange(p, dtype=data.dtype)
+        # per-bin offsets from trans (class-agnostic: trans dim 2)
+        if trans is not None:
+            t = trans[r_idx]                     # (2*cls, part, part)
+            pi = jnp.clip((i / p * part).astype(jnp.int32), 0, part - 1)
+            dx = t[0][pi][:, pi] * trans_std * rw   # (p, p)
+            dy = t[1][pi][:, pi] * trans_std * rh
+        else:
+            dx = dy = jnp.zeros((p, p), data.dtype)
+        # sample points per bin: (p, p, sp, sp)
+        ss = (jnp.arange(sp, dtype=data.dtype) + 0.5) / sp
+        ys = (y1 + i[:, None, None, None] * bh
+              + ss[None, None, :, None] * bh + dy[:, :, None, None])
+        xs = (x1 + i[None, :, None, None] * bw
+              + ss[None, None, None, :] * bw + dx[:, :, None, None])
+        vals = _bilinear_sample_one(img, jnp.clip(xs, 0, W - 1),
+                                    jnp.clip(ys, 0, H - 1))
+        avg = vals.mean(axis=(3, 4))             # (C, p, p)
+        c_out = jnp.arange(output_dim)
+        i_idx = jnp.arange(p)
+        gi = jnp.clip((i_idx * g) // p, 0, g - 1)
+        cmap = ((c_out[:, None, None] * g + gi[None, :, None]) * g
+                + gi[None, None, :])
+        return avg[cmap, i_idx[None, :, None], i_idx[None, None, :]]
+
+    return jax.vmap(one)(rois, jnp.arange(rois.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_DeformableConvolution")
+def _deformable_convolution(data, offset, weight, *rest, kernel,
+                            num_filter, stride=None, dilate=None,
+                            pad=None, num_group=1, num_deformable_group=1,
+                            no_bias=True, workspace=1024, layout=None):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc):
+    each kernel tap samples the input at a per-position learned offset;
+    expressed as K*K bilinear gathers + one dense contraction (MXU)."""
+    kh, kw = tuple_param(kernel, 2)
+    sh, sw = tuple_param(stride, 2) or (1, 1)
+    dh, dw = tuple_param(dilate, 2) or (1, 1)
+    phh, pww = tuple_param(pad, 2) or (0, 0)
+    if num_group != 1 or num_deformable_group != 1:
+        raise MXNetError("DeformableConvolution: groups>1 not supported")
+    N, C, H, W = data.shape
+    Ho = (H + 2 * phh - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pww - (dw * (kw - 1) + 1)) // sw + 1
+    hbase = jnp.arange(Ho) * sh - phh
+    wbase = jnp.arange(Wo) * sw - pww
+    taps = []
+    for ki in range(kh):
+        for kj in range(kw):
+            t = 2 * (ki * kw + kj)
+            dy = offset[:, t]                     # (N, Ho, Wo)
+            dx = offset[:, t + 1]
+            gy = hbase[None, :, None] + ki * dh + dy
+            gx = wbase[None, None, :] + kj * dw + dx
+            taps.append(jax.vmap(_bilinear_sample_one)(data, gx, gy))
+    # (kh*kw, N, C, Ho, Wo) x (O, C, kh, kw) -> (N, O, Ho, Wo)
+    stack = jnp.stack(taps)
+    wmat = weight.reshape(weight.shape[0], C, kh * kw)
+    y = jnp.einsum("knchw,ock->nohw", stack, wmat)
+    if not no_bias and rest:
+        y = y + rest[0].reshape(1, -1, 1, 1).astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# region proposals (RPN)
+# ---------------------------------------------------------------------------
+
+
+def _make_anchors(feature_stride, scales, ratios):
+    """Base anchors centered on one cell (reference:
+    rcnn/generate_anchor-style enumeration)."""
+    base = feature_stride
+    px, py = (base - 1) / 2.0, (base - 1) / 2.0
+    anchors = []
+    area = base * base
+    for r in ratios:
+        ws = np.round(np.sqrt(area / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            anchors.append([px - (w - 1) / 2, py - (h - 1) / 2,
+                            px + (w - 1) / 2, py + (h - 1) / 2])
+    return np.array(anchors, "float32")          # (A, 4)
+
+
+def _nms_fixed(boxes, scores, thresh, k):
+    """Greedy NMS with a static trip count (lax.fori_loop)."""
+    def iou(b, rest):
+        x1 = jnp.maximum(b[0], rest[:, 0])
+        y1 = jnp.maximum(b[1], rest[:, 1])
+        x2 = jnp.minimum(b[2], rest[:, 2])
+        y2 = jnp.minimum(b[3], rest[:, 3])
+        inter = jnp.maximum(x2 - x1 + 1, 0) * jnp.maximum(y2 - y1 + 1, 0)
+        area = lambda bb: (bb[..., 2] - bb[..., 0] + 1) * \
+            (bb[..., 3] - bb[..., 1] + 1)
+        return inter / (area(b) + area(rest) - inter + 1e-9)
+
+    n = boxes.shape[0]
+
+    def body(i, state):
+        sup, keep = state
+        avail = jnp.where(sup, -jnp.inf, scores)
+        j = jnp.argmax(avail)
+        keep = keep.at[i].set(jnp.where(jnp.isfinite(avail[j]), j, -1))
+        overl = iou(boxes[j], boxes)
+        sup = sup | (overl > thresh) | (jnp.arange(n) == j)
+        return sup, keep
+
+    sup0 = jnp.zeros((n,), bool)
+    keep0 = jnp.full((k,), -1, jnp.int32)
+    _, keep = lax.fori_loop(0, k, body, (sup0, keep0))
+    return keep
+
+
+def _proposal_one(scores, deltas, im_info, anchors, feature_stride,
+                  pre_nms, post_nms, thresh, min_size):
+    """Single-image RPN proposal (reference: contrib/proposal.cc)."""
+    A = anchors.shape[0]
+    H, W = scores.shape[1], scores.shape[2]
+    sy = jnp.arange(H) * feature_stride
+    sx = jnp.arange(W) * feature_stride
+    shift = jnp.stack(
+        [jnp.tile(sx[None, :], (H, 1)), jnp.tile(sy[:, None], (1, W)),
+         jnp.tile(sx[None, :], (H, 1)), jnp.tile(sy[:, None], (1, W))],
+        axis=-1)                                     # (H, W, 4)
+    all_anchors = (anchors[None, None] + shift[:, :, None]).reshape(-1, 4)
+    sc = scores.transpose(1, 2, 0).reshape(-1)       # (H*W*A,)
+    dl = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+
+    # bbox transform (reference: BBoxTransformInv)
+    w = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+    h = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+    cx = all_anchors[:, 0] + 0.5 * (w - 1)
+    cy = all_anchors[:, 1] + 0.5 * (h - 1)
+    ncx = dl[:, 0] * w + cx
+    ncy = dl[:, 1] * h + cy
+    nw = jnp.exp(jnp.clip(dl[:, 2], -10, 10)) * w
+    nh = jnp.exp(jnp.clip(dl[:, 3], -10, 10)) * h
+    boxes = jnp.stack([ncx - 0.5 * (nw - 1), ncy - 0.5 * (nh - 1),
+                       ncx + 0.5 * (nw - 1), ncy + 0.5 * (nh - 1)], -1)
+    # clip to image
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 1], 0, im_info[0] - 1),
+                       jnp.clip(boxes[:, 2], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 3], 0, im_info[0] - 1)], -1)
+    # min size filter (scaled by im_info[2])
+    ms = min_size * im_info[2]
+    keepable = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms) &
+                (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+    sc = jnp.where(keepable, sc, -jnp.inf)
+
+    pre = min(pre_nms, sc.shape[0])
+    top_sc, top_idx = lax.top_k(sc, pre)
+    top_boxes = boxes[top_idx]
+    keep = _nms_fixed(top_boxes, top_sc, thresh, post_nms)
+    valid = keep >= 0
+    keep_safe = jnp.clip(keep, 0, pre - 1)
+    out_boxes = jnp.where(valid[:, None], top_boxes[keep_safe], 0.0)
+    out_scores = jnp.where(valid, top_sc[keep_safe], 0.0)
+    return out_boxes, out_scores
+
+
+def _proposal_impl(cls_prob, bbox_pred, im_info, *, scales, ratios,
+                   feature_stride, rpn_pre_nms_top_n, rpn_post_nms_top_n,
+                   threshold, rpn_min_size, output_score):
+    anchors = jnp.asarray(_make_anchors(feature_stride, scales, ratios))
+    A = anchors.shape[0]
+    fg = cls_prob[:, A:]                       # (N, A, H, W) fg scores
+
+    def one(s, d, info):
+        return _proposal_one(s, d, info, anchors, feature_stride,
+                             int(rpn_pre_nms_top_n),
+                             int(rpn_post_nms_top_n), threshold,
+                             rpn_min_size)
+
+    boxes, scores = jax.vmap(one)(fg, bbox_pred, im_info)
+    n, k = boxes.shape[0], boxes.shape[1]
+    bidx = jnp.broadcast_to(jnp.arange(n, dtype=boxes.dtype)[:, None, None],
+                            (n, k, 1))
+    rois = jnp.concatenate([bidx, boxes], axis=-1).reshape(n * k, 5)
+    if output_score:
+        return rois, scores.reshape(n * k, 1)
+    return rois
+
+
+@register("_contrib_Proposal")
+def _proposal(cls_prob, bbox_pred, im_info, *, scales=(4, 8, 16, 32),
+              ratios=(0.5, 1, 2), feature_stride=16,
+              rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+              threshold=0.7, rpn_min_size=16, output_score=False,
+              iou_loss=False):
+    """RPN proposals (reference: contrib/proposal.cc)."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, scales=scales,
+                          ratios=ratios, feature_stride=feature_stride,
+                          rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+                          rpn_post_nms_top_n=rpn_post_nms_top_n,
+                          threshold=threshold, rpn_min_size=rpn_min_size,
+                          output_score=output_score)
+
+
+@register("_contrib_MultiProposal")
+def _multi_proposal(cls_prob, bbox_pred, im_info, *, scales=(4, 8, 16, 32),
+                    ratios=(0.5, 1, 2), feature_stride=16,
+                    rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+                    threshold=0.7, rpn_min_size=16, output_score=False,
+                    iou_loss=False):
+    """Batched RPN proposals (reference: contrib/multi_proposal.cc) —
+    identical math, vmapped over the batch like _contrib_Proposal."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, scales=scales,
+                          ratios=ratios, feature_stride=feature_stride,
+                          rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+                          rpn_post_nms_top_n=rpn_post_nms_top_n,
+                          threshold=threshold, rpn_min_size=rpn_min_size,
+                          output_score=output_score)
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (hinge-loss head) + SyncBatchNorm
+# ---------------------------------------------------------------------------
+
+
+def _svm_grad(scores, label, margin, coef, use_linear):
+    n_class = scores.shape[-1]
+    lbl = label.astype(jnp.int32)
+    one_hot = jax.nn.one_hot(lbl, n_class, dtype=scores.dtype)
+    s_true = jnp.sum(scores * one_hot, axis=-1, keepdims=True)
+    viol = margin - (s_true - scores)          # >0 where margin violated
+    viol = jnp.where(one_hot > 0, 0.0, viol)
+    if use_linear:
+        g = (viol > 0).astype(scores.dtype) * coef
+    else:
+        g = jnp.maximum(viol, 0.0) * 2.0 * coef
+    g_true = -jnp.sum(g, axis=-1, keepdims=True)
+    return g + one_hot * g_true
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, coef, use_linear, res, g):
+    data, label = res
+    return _svm_grad(data, label, margin, coef, use_linear), None
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput")
+def _svm_output(data, label, *, margin=1.0,
+                regularization_coefficient=1.0, use_linear=False):
+    """Hinge-loss head (reference: svm_output.cc): forward identity,
+    backward = margin-violation gradient."""
+    return _svm_core(data, label, margin, regularization_coefficient,
+                     bool(use_linear))
+
+
+@register("_contrib_SyncBatchNorm", num_outputs=5,
+          visible_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
+          aux_write={3: 3, 4: 4}, takes_mode=True)
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
+                     eps=1e-3, momentum=0.9, fix_gamma=True,
+                     use_global_stats=False, output_mean_var=False,
+                     ndev=1, key="sync", axis=1, _mode="predict"):
+    """Synchronized BatchNorm (reference: contrib/sync_batch_norm.cc).
+
+    TPU-native: under jit over a sharded batch, XLA's SPMD partitioner
+    already computes GLOBAL batch statistics for plain BatchNorm (the
+    mean/var reductions psum over the dp axis automatically) — so cross-
+    device sync is the default behavior of the fused path, not an extra
+    op. This alias keeps the reference API (ndev/key accepted) and
+    delegates to BatchNorm.
+    """
+    from .nn import _batch_norm
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var,
+                       eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats,
+                       output_mean_var=output_mean_var, axis=axis,
+                       _mode=_mode)

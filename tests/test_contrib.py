@@ -25,7 +25,8 @@ def test_quantize_model_close_to_fp32():
             "fc2_bias": mx.nd.zeros((4,))}
     it = mx.io.NDArrayIter(X, np.zeros(64, "float32"), batch_size=16)
     qsym, qargs, _ = quantize_model(net, args, {}, calib_data=it,
-                                    num_calib_examples=32)
+                                    num_calib_examples=32,
+                                    quantize_mode="qdq")
     common = {"data": mx.nd.array(X[:16]),
               "softmax_label": mx.nd.zeros((16,))}
     out_fp = net.bind(mx.cpu(), args={**args, **common},

@@ -1,0 +1,195 @@
+"""Breadth-op tests: fused optimizer updates vs numpy oracles,
+distribution samplers, misc tensor ops, LibSVMIter.
+
+Reference: optimizer_op.cc update formulas, sample_op.cc,
+tensor extras, src/io/iter_libsvm.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestOptimizerUpdateOps:
+    def test_sgd_update(self):
+        w = np.array([1.0, -2.0], "f")
+        g = np.array([0.5, 0.5], "f")
+        out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+        ref = w - 0.1 * (g + 0.01 * w)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+    def test_sgd_mom_update_mutates_mom(self):
+        w = nd.array(np.ones(3, "f"))
+        g = nd.array(np.full(3, 0.5, "f"))
+        mom = nd.zeros((3,))
+        out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+        np.testing.assert_allclose(out.asnumpy(), 1 - 0.05, rtol=1e-6)
+        np.testing.assert_allclose(mom.asnumpy(), -0.05, rtol=1e-6)
+        out2 = nd.sgd_mom_update(out, g, mom, lr=0.1, momentum=0.9)
+        # mom' = 0.9*(-0.05) - 0.05 = -0.095
+        np.testing.assert_allclose(mom.asnumpy(), -0.095, rtol=1e-5)
+
+    def test_adam_update_oracle(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(4).astype("f")
+        g = rng.randn(4).astype("f")
+        m = np.zeros(4, "f")
+        v = np.zeros(4, "f")
+        mn, vn = nd.array(m), nd.array(v)
+        out = nd.adam_update(nd.array(w), nd.array(g), mn, vn, lr=0.01)
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        ref = w - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+        np.testing.assert_allclose(mn.asnumpy(), m_ref, rtol=1e-5)
+
+    def test_mp_sgd_keeps_fp32_master(self):
+        w16 = nd.array(np.ones(3, "f")).astype("float16")
+        w32 = nd.array(np.ones(3, "f"))
+        g = nd.array(np.full(3, 1e-4, "f")).astype("float16")
+        out = nd.mp_sgd_update(w16, g, w32, lr=1.0)
+        # fp32 master moved by 1e-4 even though fp16 cannot hold 1-1e-4
+        np.testing.assert_allclose(w32.asnumpy(), 1 - 1e-4, rtol=1e-6)
+        assert out.dtype == np.float16
+
+    def test_signsgd_and_signum(self):
+        w = nd.array(np.zeros(2, "f"))
+        g = nd.array(np.array([0.3, -0.7], "f"))
+        out = nd.signsgd_update(w, g, lr=0.1)
+        np.testing.assert_allclose(out.asnumpy(), [-0.1, 0.1], atol=1e-7)
+        mom = nd.zeros((2,))
+        out2 = nd.signum_update(w, g, mom, lr=0.1, momentum=0.9)
+        np.testing.assert_allclose(out2.asnumpy(), [-0.1, 0.1],
+                                   atol=1e-7)
+
+    def test_ftrl_sparsifies(self):
+        w = nd.array(np.full(2, 0.5, "f"))
+        g = nd.array(np.array([1e-4, 5.0], "f"))
+        z = nd.zeros((2,))
+        n = nd.zeros((2,))
+        out = nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=0.01)
+        got = out.asnumpy()
+        assert got[0] == 0.0          # |z| <= lambda1 -> exactly zero
+        assert got[1] != 0.0
+
+    def test_rmsprop(self):
+        w = nd.array(np.ones(2, "f"))
+        g = nd.array(np.full(2, 2.0, "f"))
+        n = nd.zeros((2,))
+        out = nd.rmsprop_update(w, g, n, lr=0.1, gamma1=0.9)
+        n_ref = 0.1 * 4.0
+        ref = 1 - 0.1 * 2.0 / np.sqrt(n_ref + 1e-8)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+class TestSamplers:
+    def test_sample_exponential_mean(self):
+        lam = nd.array(np.array([2.0, 0.5], "f"))
+        s = nd.sample_exponential(lam, shape=(4000,)) \
+            if hasattr(nd, "sample_exponential") else \
+            nd._sample_exponential(lam, shape=(4000,))
+        a = s.asnumpy()
+        assert a.shape == (2, 4000)
+        assert abs(a[0].mean() - 0.5) < 0.08
+        assert abs(a[1].mean() - 2.0) < 0.25
+
+    def test_sample_gamma_mean(self):
+        alpha = nd.array(np.array([3.0], "f"))
+        beta = nd.array(np.array([2.0], "f"))
+        s = nd._sample_gamma(alpha, beta, shape=(4000,))
+        assert abs(s.asnumpy().mean() - 6.0) < 0.5
+
+    def test_sample_poisson(self):
+        lam = nd.array(np.array([4.0], "f"))
+        s = nd._sample_poisson(lam, shape=(4000,))
+        assert abs(s.asnumpy().mean() - 4.0) < 0.3
+
+    def test_sample_negative_binomial(self):
+        k = nd.array(np.array([5.0], "f"))
+        p = nd.array(np.array([0.5], "f"))
+        s = nd._sample_negative_binomial(k, p, shape=(4000,))
+        # mean = k(1-p)/p = 5
+        assert abs(s.asnumpy().mean() - 5.0) < 0.6
+
+
+class TestMiscOps:
+    def test_histogram(self):
+        x = nd.array(np.array([0.1, 0.4, 0.6, 0.9, 0.9], "f"))
+        cnt, edges = nd._histogram(x, bin_cnt=2, range=(0.0, 1.0))
+        np.testing.assert_array_equal(cnt.asnumpy(), [2, 3])
+
+    def test_ravel_unravel_roundtrip(self):
+        idx = nd.array(np.array([[1, 2], [3, 0]], "f"))  # (ndim=2, N=2)
+        flat = nd._ravel_multi_index(idx, shape=(4, 5))
+        np.testing.assert_array_equal(flat.asnumpy(), [8, 10])
+        back = nd._unravel_index(flat, shape=(4, 5))
+        np.testing.assert_array_equal(back.asnumpy(), idx.asnumpy())
+
+    def test_logical_ops(self):
+        a = nd.array(np.array([0, 1, 2], "f"))
+        b = nd.array(np.array([1, 0, 3], "f"))
+        np.testing.assert_array_equal(
+            nd._logical_and(a, b).asnumpy(), [0, 0, 1])
+        np.testing.assert_array_equal(
+            nd._logical_or(a, b).asnumpy(), [1, 1, 1])
+        np.testing.assert_array_equal(
+            nd._logical_xor(a, b).asnumpy(), [1, 1, 0])
+
+    def test_slice_assign(self):
+        x = nd.zeros((4, 4))
+        y = nd._slice_assign(x, nd.ones((2, 2)), begin=(1, 1),
+                             end=(3, 3))
+        got = y.asnumpy()
+        assert got[1:3, 1:3].sum() == 4 and got.sum() == 4
+
+    def test_square_sum_and_hard_sigmoid(self):
+        x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], "f"))
+        np.testing.assert_allclose(
+            nd._square_sum(x, axis=1).asnumpy(), [5, 25])
+        h = nd.hard_sigmoid(nd.array(np.array([-10, 0, 10], "f")))
+        np.testing.assert_allclose(h.asnumpy(), [0, 0.5, 1])
+
+    def test_softmax_cross_entropy(self):
+        logits = np.array([[10.0, 0.0], [0.0, 10.0]], "f")
+        lbl = np.array([0, 1], "f")
+        out = nd.softmax_cross_entropy(nd.array(logits), nd.array(lbl))
+        assert out.shape == (1,)
+        assert float(out.asnumpy()[0]) < 0.01
+
+    def test_bipartite_matching(self):
+        score = nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], "f"))
+        r, c = nd.contrib.bipartite_matching(score, threshold=0.05)
+        np.testing.assert_array_equal(r.asnumpy(), [0, 1])
+        np.testing.assert_array_equal(c.asnumpy(), [0, 1])
+
+    def test_image_to_tensor_and_normalize(self):
+        img = nd.array((np.ones((4, 5, 3)) * 255).astype("f"))
+        t = nd._image_to_tensor(img)
+        assert t.shape == (3, 4, 5)
+        np.testing.assert_allclose(t.asnumpy(), 1.0)
+        nrm = nd._image_normalize(t, mean=(1, 1, 1), std=(0.5, 0.5, 0.5))
+        np.testing.assert_allclose(nrm.asnumpy(), 0.0)
+
+
+class TestLibSVMIter:
+    def test_reads_csr_batches(self, tmp_path):
+        path = tmp_path / "data.libsvm"
+        path.write_text(
+            "1 0:1.5 3:2.0\n"
+            "0 1:1.0\n"
+            "1 2:3.0 4:4.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(5,),
+                              batch_size=2, round_batch=True)
+        b1 = it.next()
+        assert b1.data[0].stype == "csr"
+        dense = b1.data[0].asnumpy()
+        np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0, 0])
+        np.testing.assert_allclose(dense[1], [0, 1.0, 0, 0, 0])
+        np.testing.assert_array_equal(b1.label[0].asnumpy(), [1, 0])
+        b2 = it.next()
+        assert b2.pad == 1
+        with pytest.raises(StopIteration):
+            it.next()
+        it.reset()
+        assert it.next().label[0].asnumpy()[0] == 1

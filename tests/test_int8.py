@@ -1,0 +1,165 @@
+"""int8 quantization: quantized ops + end-to-end int8 resnet-18 parity.
+
+Reference: src/operator/quantization/{quantized_conv.cc,
+quantized_pooling.cc}, python/mxnet/contrib/quantization.py (naive +
+entropy calibration, quantize_model).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestQuantizedOps:
+    def test_quantized_conv_matches_float_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype("f")
+        w = rng.randn(4, 3, 3, 3).astype("f")
+        qx, xmin, xmax = nd.contrib.quantize(
+            nd.array(x), nd.array([x.min()]), nd.array([x.max()]))
+        qw, wmin, wmax = nd.contrib.quantize(
+            nd.array(w), nd.array([w.min()]), nd.array([w.max()]))
+        zero = nd.zeros((1,))
+        acc, omin, omax = nd.contrib.quantized_conv(
+            qx, qw, nd.zeros((4,), dtype="int8"), xmin, xmax, wmin, wmax,
+            zero, zero, kernel=(3, 3), num_filter=4, no_bias=True)
+        assert acc.dtype == np.int32
+        # dequantize the accumulator and compare against the fp32 conv
+        scale = float(omax.asnumpy()[0]) / (2.0 ** 31 - 1)
+        got = acc.asnumpy() * scale
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             num_filter=4, no_bias=True).asnumpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err  # int8 rounding only
+
+    def test_quantized_pooling_exact_for_max(self):
+        rng = np.random.RandomState(1)
+        q = rng.randint(-127, 128, (1, 2, 6, 6)).astype(np.int8)
+        mn, mx_ = nd.array([-1.0]), nd.array([1.0])
+        y, omin, omax = nd.contrib.quantized_pooling(
+            nd.array(q), mn, mx_, kernel=(2, 2), stride=(2, 2),
+            pool_type="max")
+        ref = nd.Pooling(nd.array(q.astype("f")), kernel=(2, 2),
+                         stride=(2, 2), pool_type="max").asnumpy()
+        np.testing.assert_array_equal(y.asnumpy().astype("f"), ref)
+        assert float(omin.asnumpy()[0]) == -1.0
+
+    def test_int8_conv_sandwich(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 8, 8).astype("f")
+        w = 0.2 * rng.randn(4, 3, 3, 3).astype("f")
+        amax = float(np.abs(x).max())
+        y = nd._contrib_int8_conv(nd.array(x), nd.array(w),
+                                  amax_data=amax, kernel=(3, 3),
+                                  num_filter=4) \
+            if hasattr(nd, "_contrib_int8_conv") else None
+        if y is None:
+            from mxnet_tpu.ndarray import invoke
+            from mxnet_tpu.ops import registry
+            y = invoke(registry.get("_contrib_int8_conv"),
+                       [nd.array(x), nd.array(w)],
+                       {"amax_data": amax, "kernel": (3, 3),
+                        "num_filter": 4, "no_bias": True})[0]
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             num_filter=4, no_bias=True).asnumpy()
+        err = np.abs(y.asnumpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+
+def _calib_iter(x, batch=4):
+    return mx.io.NDArrayIter(x, np.zeros((x.shape[0],), "f"),
+                             batch_size=batch,
+                             label_name="softmax_label")
+
+
+class TestQuantizeModel:
+    def _small_convnet(self):
+        d = mx.sym.var("data")
+        c = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                               name="conv0")
+        r = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(r, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+        f = mx.sym.FullyConnected(p, num_hidden=10, name="fc0")
+        return mx.sym.SoftmaxOutput(f, name="softmax")
+
+    def _params_for(self, sym, xshape):
+        rng = np.random.RandomState(3)
+        arg_shapes, _, aux_shapes = sym.infer_shape(
+            data=xshape, softmax_label=(xshape[0],))
+        args, auxs = {}, {}
+        for name, shape in zip(sym.list_arguments(), arg_shapes):
+            if name in ("data", "softmax_label"):
+                continue
+            args[name] = nd.array(0.2 * rng.randn(*shape).astype("f"))
+        for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+            auxs[name] = nd.zeros(shape)
+        return args, auxs
+
+    @pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+    def test_int8_forward_parity(self, calib_mode):
+        rng = np.random.RandomState(4)
+        sym = self._small_convnet()
+        x = rng.randn(16, 3, 8, 8).astype("f")
+        args, auxs = self._params_for(sym, (4, 3, 8, 8))
+        qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+            sym, args, auxs, calib_data=_calib_iter(x),
+            calib_mode=calib_mode, quantize_mode="full",
+            excluded_sym_names=[])
+
+        def score(s, a, au):
+            ex = s.bind(None, args={**a, "data": nd.array(x[:4]),
+                                    "softmax_label": nd.zeros((4,))},
+                        aux_states=dict(au), grad_req="null")
+            return ex.forward(is_train=False)[0].asnumpy()
+
+        ref = score(sym, args, auxs)
+        got = score(qsym, qargs, qauxs)
+        # int8 parity: same argmax on (nearly) all samples
+        agree = (ref.argmax(1) == got.argmax(1)).mean()
+        assert agree >= 0.75, agree
+        # the rewrite really lowered to int8 compute
+        assert "_contrib_int8_conv" in qsym.tojson()
+
+    def test_int8_resnet18_forward_parity(self):
+        """int8 resnet-18 runs end-to-end and agrees with fp32 top-1
+        (the point of the reference quantization subsystem)."""
+        from mxnet_tpu.gluon.model_zoo import vision
+        rng = np.random.RandomState(5)
+        net = vision.resnet18_v1(classes=10)
+        net.initialize()
+        x = rng.randn(8, 3, 32, 32).astype("f")
+        net(mx.nd.array(x))  # materialize
+
+        data = mx.sym.var("data")
+        out = net(data)
+        args = {p.name: p.data() for p in net.collect_params().values()
+                if p.name in out.list_arguments()}
+        auxs = {p.name: p.data() for p in net.collect_params().values()
+                if p.name in out.list_auxiliary_states()}
+
+        qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+            out, args, auxs, calib_data=_calib_iter(x),
+            calib_mode="naive", quantize_mode="full",
+            label_names=None)
+
+        def score(s, a, au):
+            ex = s.bind(None, args={**a, "data": nd.array(x)},
+                        aux_states=dict(au), grad_req="null")
+            return ex.forward(is_train=False)[0].asnumpy()
+
+        ref = score(out, args, auxs)
+        got = score(qsym, qargs, qauxs)
+        agree = (ref.argmax(1) == got.argmax(1)).mean()
+        assert agree >= 0.75, agree
+
+    def test_entropy_threshold_tightens_range(self):
+        # heavy-tailed activations: KL threshold must clip the tail
+        from mxnet_tpu.contrib.quantization import _optimal_threshold_kl
+        rng = np.random.RandomState(6)
+        a = np.abs(rng.randn(100000)).astype("f")
+        a[:10] = 50.0  # outliers
+        h, edges = np.histogram(a, bins=2048, range=(0, 50.0))
+        thr = _optimal_threshold_kl(h, edges[1:])
+        assert thr < 25.0, thr  # far below the outlier max

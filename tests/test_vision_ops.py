@@ -1,0 +1,210 @@
+"""Vision/detection operator tests: spatial transformer family, ROI
+pooling family, deformable conv, proposals, SVMOutput.
+
+Reference behaviors pinned against independent numpy oracles and
+numeric-gradient checks (the reference's test_operator.py strategy for
+these ops: check_numeric_gradient + hand oracles).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+class TestGridBilinear:
+    def test_identity_affine_reproduces_input(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 5, 7).astype("f")
+        theta = np.tile(np.array([1, 0, 0, 0, 1, 0], "f"), (2, 1))
+        g = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                             target_shape=(5, 7))
+        y = nd.BilinearSampler(nd.array(x), g)
+        np.testing.assert_allclose(y.asnumpy(), x, rtol=1e-4, atol=1e-4)
+
+    def test_translation_shifts(self):
+        x = np.zeros((1, 1, 5, 5), "f")
+        x[0, 0, 2, 2] = 1.0
+        # shift sampling grid one pixel right: x_src = x_dst + 2/(W-1)
+        theta = np.array([[1, 0, 0.5, 0, 1, 0]], "f")
+        y = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                  target_shape=(5, 5))
+        got = y.asnumpy()[0, 0]
+        assert got[2, 1] == pytest.approx(1.0, abs=1e-4), got
+
+    def test_warp_grid(self):
+        flow = np.zeros((1, 2, 4, 4), "f")
+        g = nd.GridGenerator(nd.array(flow), transform_type="warp")
+        # zero flow = identity grid in [-1, 1]
+        gx = g.asnumpy()[0, 0]
+        np.testing.assert_allclose(gx[0], np.linspace(-1, 1, 4),
+                                   atol=1e-6)
+
+    def test_bilinear_sampler_gradients(self):
+        rng = np.random.RandomState(1)
+        data = mx.sym.var("data")
+        grid = mx.sym.var("grid")
+        out = mx.sym.BilinearSampler(data, grid)
+        loc = {"data": rng.randn(1, 2, 5, 5).astype("f"),
+               "grid": (rng.rand(1, 2, 3, 3).astype("f") - 0.5)}
+        check_numeric_gradient(out, loc, numeric_eps=1e-3, rtol=6e-2,
+                               atol=6e-2)
+
+    def test_spatial_transformer_gradient_wrt_loc(self):
+        rng = np.random.RandomState(2)
+        data = mx.sym.var("data")
+        loc = mx.sym.var("loc")
+        out = mx.sym.SpatialTransformer(data, loc, target_shape=(4, 4))
+        location = {"data": rng.randn(1, 2, 6, 6).astype("f"),
+                    "loc": np.array([[1, 0.1, 0, -0.1, 1, 0]], "f")}
+        check_numeric_gradient(out, location, numeric_eps=1e-3,
+                               rtol=6e-2, atol=6e-2)
+
+
+class TestROIFamily:
+    def test_roi_pooling_oracle(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 8, 8).astype("f")
+        rois = np.array([[0, 0, 0, 3, 3]], "f")   # 4x4 region
+        y = nd.ROIPooling(nd.array(x), nd.array(rois),
+                          pooled_size=(2, 2), spatial_scale=1.0)
+        got = y.asnumpy()[0]
+        for c in range(2):
+            region = x[0, c, :4, :4]
+            expect = np.array(
+                [[region[:2, :2].max(), region[:2, 2:4].max()],
+                 [region[2:4, :2].max(), region[2:4, 2:4].max()]])
+            np.testing.assert_allclose(got[c], expect, rtol=1e-5)
+
+    def test_roi_pooling_batch_index(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 1, 4, 4).astype("f")
+        rois = np.array([[1, 0, 0, 3, 3]], "f")
+        y = nd.ROIPooling(nd.array(x), nd.array(rois),
+                          pooled_size=(1, 1), spatial_scale=1.0)
+        assert y.asnumpy()[0, 0, 0, 0] == pytest.approx(x[1, 0].max(),
+                                                        rel=1e-5)
+
+    def test_psroi_pooling_channel_map(self):
+        # C = output_dim * g * g; each bin must read its own channel
+        p = 2
+        out_dim = 1
+        C = out_dim * p * p
+        x = np.zeros((1, C, 4, 4), "f")
+        for c in range(C):
+            x[0, c] = c + 1
+        rois = np.array([[0, 0, 0, 3, 3]], "f")
+        y = nd.contrib.PSROIPooling(nd.array(x), nd.array(rois),
+                                    spatial_scale=1.0, output_dim=out_dim,
+                                    pooled_size=p, group_size=p)
+        got = y.asnumpy()[0, 0]
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]], rtol=1e-5)
+
+    def test_deformable_conv_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 7, 7).astype("f")
+        w = rng.randn(4, 3, 3, 3).astype("f")
+        off = np.zeros((2, 18, 5, 5), "f")
+        y1 = nd.contrib.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            num_filter=4)
+        y2 = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                            num_filter=4, no_bias=True)
+        np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_deformable_conv_gradient(self):
+        rng = np.random.RandomState(6)
+        d = mx.sym.var("data")
+        o = mx.sym.var("offset")
+        w = mx.sym.var("weight")
+        out = mx.sym.contrib.DeformableConvolution(
+            d, o, w, kernel=(3, 3), num_filter=2)
+        loc = {"data": rng.randn(1, 2, 5, 5).astype("f"),
+               "offset": 0.1 * rng.randn(1, 18, 3, 3).astype("f"),
+               "weight": rng.randn(2, 2, 3, 3).astype("f")}
+        check_numeric_gradient(out, loc, numeric_eps=1e-3, rtol=7e-2,
+                               atol=7e-2)
+
+
+class TestProposal:
+    def test_proposal_shapes_and_validity(self):
+        rng = np.random.RandomState(7)
+        A = 9  # 3 scales x 3 ratios
+        H = W = 6
+        cls = rng.rand(1, 2 * A, H, W).astype("f")
+        bbox = 0.1 * rng.randn(1, 4 * A, H, W).astype("f")
+        im_info = np.array([[96, 96, 1.0]], "f")
+        rois = nd.contrib.Proposal(
+            nd.array(cls), nd.array(bbox), nd.array(im_info),
+            scales=(2, 4, 8), ratios=(0.5, 1, 2), feature_stride=16,
+            rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+            threshold=0.7, rpn_min_size=4)
+        r = rois.asnumpy()
+        assert r.shape == (10, 5)
+        assert (r[:, 0] == 0).all()
+        # boxes clipped to the image
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 95).all()
+        assert (r[:, 2] >= 0).all() and (r[:, 4] <= 95).all()
+        # ordered, valid boxes
+        assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+    def test_multi_proposal_batches(self):
+        rng = np.random.RandomState(8)
+        A = 3
+        cls = rng.rand(2, 2 * A, 4, 4).astype("f")
+        bbox = 0.05 * rng.randn(2, 4 * A, 4, 4).astype("f")
+        im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], "f")
+        rois = nd.contrib.MultiProposal(
+            nd.array(cls), nd.array(bbox), nd.array(im_info),
+            scales=(4,), ratios=(0.5, 1, 2), feature_stride=16,
+            rpn_pre_nms_top_n=20, rpn_post_nms_top_n=5,
+            threshold=0.7, rpn_min_size=2)
+        r = rois.asnumpy()
+        assert r.shape == (10, 5)
+        assert set(np.unique(r[:, 0])) <= {0.0, 1.0}
+
+
+class TestSVMOutput:
+    def test_forward_identity_and_hinge_grad(self):
+        scores = np.array([[2.0, 1.0, -1.0], [0.0, 0.5, 0.2]], "f")
+        label = np.array([0, 2], "f")
+        s = nd.array(scores)
+        s.attach_grad()
+        with autograd.record():
+            out = nd.SVMOutput(s, nd.array(label), margin=1.0,
+                               regularization_coefficient=1.0,
+                               use_linear=True)
+        np.testing.assert_allclose(out.asnumpy(), scores)
+        out.backward()
+        g = s.grad.asnumpy()
+        # sample 0: true class 0 (score 2); violations: class 1
+        # (1 - (2-1) = 0, not > 0), class 2 (1 - (2-(-1)) < 0) -> no grad
+        np.testing.assert_allclose(g[0], [0, 0, 0], atol=1e-6)
+        # sample 1: true 2 (score .2); class 0: 1-(0.2-0)= .8>0;
+        # class 1: 1-(0.2-0.5)=1.3>0 -> both violate
+        np.testing.assert_allclose(g[1], [1, 1, -2], atol=1e-6)
+
+
+class TestSyncBN:
+    def test_matches_batchnorm(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(4, 3, 5, 5).astype("f")
+        args = [nd.array(x), nd.ones((3,)), nd.zeros((3,)),
+                nd.zeros((3,)), nd.ones((3,))]
+        with autograd.train_mode():
+            y1 = nd.BatchNorm(*args, fix_gamma=False)
+            y2 = nd.contrib.SyncBatchNorm(*args, fix_gamma=False,
+                                          ndev=8, key="k")
+        np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), atol=1e-5)
+
+
+class TestCrop:
+    def test_crop_offset_and_like(self):
+        x = np.arange(2 * 1 * 6 * 6, dtype="f").reshape(2, 1, 6, 6)
+        y = nd.Crop(nd.array(x), offset=(1, 2), h_w=(3, 3))
+        np.testing.assert_allclose(y.asnumpy(), x[:, :, 1:4, 2:5])
+        like = nd.zeros((2, 1, 4, 4))
+        y2 = nd.Crop(nd.array(x), like, offset=(0, 0), num_args=2)
+        assert y2.shape == (2, 1, 4, 4)
