@@ -206,8 +206,12 @@ class ShardedTrainer:
         return PartitionSpec()
 
     def _shard_param(self, name, value):
+        # private copy first: device_put aliases when the sharding already
+        # matches, and the donated step would then delete the net's (or a
+        # sibling trainer's) live buffer
         return jax.device_put(
-            value, NamedSharding(self._mesh, self._spec_for(name)))
+            jnp.array(value, copy=True),
+            NamedSharding(self._mesh, self._spec_for(name)))
 
     def _batch_sharding(self):
         spec = [None] * (self._batch_axis + 1)
