@@ -18,17 +18,19 @@ TPU-native configuration (see PERF.md for the trace-driven derivation):
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 import json
+import os
 import time
 
 import numpy as np
 
 BASELINE_IMG_S = 363.69
 SCORE_BASELINE_FP16 = 2085.51
-BATCH = 128
-SCORE_BATCH = 32
-IMG = 224
-WARMUP = 5
-STEPS = 50
+# env overrides exist for CI smoke only; the driver runs the defaults
+BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 128))
+SCORE_BATCH = int(os.environ.get("MXTPU_BENCH_SCORE_BATCH", 32))
+IMG = int(os.environ.get("MXTPU_BENCH_IMG", 224))
+WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
+STEPS = int(os.environ.get("MXTPU_BENCH_STEPS", 50))
 
 
 def main():

@@ -35,6 +35,12 @@ class BucketingModule(BaseModule):
         self._state_names = state_names
         self._context = context
         self._work_load_list = work_load_list
+        if group2ctxs is not None:
+            from ..base import MXNetError
+            raise MXNetError(
+                "group2ctxs is not wired on TPU; use "
+                "parallel.ShardedTrainer(param_rules=...) or "
+                "parallel.pipeline_apply (see SCOPE.md)")
         self._group2ctxs = group2ctxs
         self._compression_params = compression_params
 

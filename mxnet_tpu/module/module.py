@@ -28,6 +28,14 @@ class Module(BaseModule):
                  state_names=None, group2ctxs=None,
                  compression_params=None):
         super().__init__(logger=logger)
+        if group2ctxs is not None:
+            from ..base import MXNetError
+            raise MXNetError(
+                "group2ctxs (ctx_group model parallelism) is not wired "
+                "on TPU: device placement belongs to the XLA partitioner."
+                " Use parallel.ShardedTrainer(param_rules=...) for "
+                "tensor parallelism or parallel.pipeline_apply for "
+                "inter-layer (pipeline) parallelism instead.")
         if context is None:
             context = cpu()
         if isinstance(context, Context):

@@ -116,3 +116,11 @@ class TestPythonLossModule:
                 seq.update()
         after = nll()
         assert after < before, (before, after)
+
+
+def test_group2ctxs_raises_with_guidance():
+    d = mx.sym.var("data")
+    net = mx.sym.FullyConnected(d, num_hidden=2)
+    with pytest.raises(Exception, match="ShardedTrainer"):
+        mx.mod.Module(net, label_names=None,
+                      group2ctxs={"dev1": [mx.cpu()]})
