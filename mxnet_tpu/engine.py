@@ -24,7 +24,20 @@ import threading
 from .base import getenv
 
 _bulk = threading.local()
-_MODE = {"mode": getenv("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice")}
+# MXNET_ENGINE_TYPE honored too, like the reference's env selection
+_MODE = {"mode": getenv("MXTPU_ENGINE_TYPE",
+                        getenv("MXNET_ENGINE_TYPE",
+                               "ThreadedEnginePerDevice"))}
+
+
+def _naive_sync_hook(outs):
+    """In NaiveEngine mode every eager op blocks before returning, so
+    failures surface at their call site (reference: naive_engine.cc
+    executes synchronously on the caller thread)."""
+    if _MODE["mode"] == "NaiveEngine":
+        for o in outs:
+            o.wait_to_read()
+    return outs
 
 
 def set_bulk_size(size):
@@ -52,23 +65,16 @@ def engine_type():
 def deterministic():
     """Serial oracle mode (the reference's NaiveEngine): block after every
     eager op so failures surface at their call site, not at a later sync
-    point. Usage: with engine.deterministic(): ..."""
-    from .ndarray import ndarray as _nd_mod
+    point. Usage: with engine.deterministic(): ...
+
+    The same mode activates process-wide when MXTPU_ENGINE_TYPE or
+    MXNET_ENGINE_TYPE is set to "NaiveEngine" before import (the
+    reference's env selection, engine.cc CreateEngine)."""
     prev = _MODE["mode"]
     _MODE["mode"] = "NaiveEngine"
-    orig_invoke = _nd_mod.invoke
-
-    def sync_invoke(op, inputs, params, name=None):
-        outs = orig_invoke(op, inputs, params, name)
-        for o in outs:
-            o.wait_to_read()
-        return outs
-
-    _nd_mod.invoke = sync_invoke
     try:
         yield
     finally:
-        _nd_mod.invoke = orig_invoke
         _MODE["mode"] = prev
 
 
@@ -92,8 +98,9 @@ def host_engine(num_workers=None):
             from . import _native
             if _native.ensure_built() is None:
                 return None
-            n = num_workers or int(getenv("MXTPU_CPU_WORKER_NTHREADS",
-                                          "4"))
+            n = num_workers or (
+                1 if _MODE["mode"] == "NaiveEngine"
+                else int(getenv("MXTPU_CPU_WORKER_NTHREADS", "4")))
             _host_engine = _native.NativeEngine(n)
         return _host_engine
 

@@ -491,6 +491,8 @@ def invoke(op, inputs, params, name=None):
         autograd._record(op, inputs, outputs, raw, vjp_fn)
     if prof_t0 is not None:
         _prof.record_op(op.name, prof_t0, time.perf_counter())
+    from .. import engine as _engine
+    _engine._naive_sync_hook(outputs)
     return outputs
 
 

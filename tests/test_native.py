@@ -239,3 +239,25 @@ def test_waitall_is_a_fence_and_raises_engine_errors():
     eng.push(boom, mutable_vars=[v])
     with pytest.raises(Exception, match="fence-sees-this"):
         mx.nd.waitall()
+
+
+def test_naive_engine_env_selection():
+    """MXNET_ENGINE_TYPE=NaiveEngine selects the serial oracle at import
+    (reference: engine.cc CreateEngine env dispatch)."""
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MXNET_ENGINE_TYPE"] = "NaiveEngine"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import engine\n"
+        "assert engine.engine_type() == 'NaiveEngine'\n"
+        "a = mx.nd.ones((4,)) + 1\n"  # runs synchronously\n
+        "print('NAIVE_OK')\n")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "NAIVE_OK" in r.stdout
