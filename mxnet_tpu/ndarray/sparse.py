@@ -237,19 +237,49 @@ def retain(arr, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse dot (reference: tensor/dot-inl.h): csr × dense and
-    csr^T × dense — the wide-and-deep / linear-model hot path."""
+    """Sparse dot (reference: tensor/dot-inl.h DotCsrDnsDns /
+    DotCsrTDnsDns): csr × dense and csr^T × dense — the wide-and-deep /
+    linear-model hot path. TRUE sparse compute, O(nnz·K): a gather of
+    the touched weight rows + a segment scatter-add; the dense table is
+    never materialized from the CSR side."""
     if isinstance(lhs, CSRNDArray):
-        dense = lhs._to_dense_jax()
-        if transpose_a:
-            dense = dense.T
-        out = jnp.matmul(dense, rhs._data.T if transpose_b else rhs._data)
+        w = rhs._data.T if transpose_b else rhs._data
+        vals = lhs.data._data
+        cols = lhs.indices._data.astype(jnp.int32)
+        indptr = np.asarray(lhs.indptr._data)
+        n_rows = lhs.shape[0]
+        row_ids = jnp.asarray(
+            np.repeat(np.arange(n_rows, dtype=np.int32),
+                      np.diff(indptr)))
+        if not transpose_a:
+            # (N, D) x (D, K): contrib[p] = vals[p] * W[cols[p]]
+            contrib = vals[:, None] * jnp.take(w, cols, axis=0)
+            out = jnp.zeros((n_rows, w.shape[1]),
+                            contrib.dtype).at[row_ids].add(contrib)
+        else:
+            # (D, N) x (N, K): scatter into the column dimension
+            contrib = vals[:, None] * jnp.take(w, row_ids, axis=0)
+            out = jnp.zeros((lhs.shape[1], w.shape[1]),
+                            contrib.dtype).at[cols].add(contrib)
         return NDArray(out, rhs._ctx)
     if isinstance(lhs, RowSparseNDArray):
-        dense = lhs._to_dense_jax()
-        if transpose_a:
-            dense = dense.T
-        return NDArray(jnp.matmul(dense, rhs._data), rhs._ctx)
+        vals = lhs.data._data
+        idx = lhs.indices._data.astype(jnp.int32)
+        w = rhs._data
+        if not transpose_a:
+            # (N, D) x (D, K): only stored rows contribute rows of out
+            rows = vals @ w
+            n = lhs.shape[0]
+            safe = jnp.clip(idx, 0, n - 1)
+            mask = (idx < n).reshape(-1, *([1] * (rows.ndim - 1)))
+            out = jnp.zeros((n, w.shape[1]), rows.dtype).at[safe].add(
+                jnp.where(mask, rows, 0))
+        else:
+            # (D, N) x (N, K): gather the touched rows of rhs
+            gathered = jnp.take(w, jnp.clip(idx, 0, w.shape[0] - 1),
+                                axis=0)
+            out = vals.T @ gathered
+        return NDArray(out, rhs._ctx)
     raise MXNetError("sparse.dot: unsupported operand types")
 
 

@@ -84,3 +84,68 @@ def test_padding_rows_are_ignored():
     got = out.asnumpy()
     np.testing.assert_allclose(got[1], [5., 5.])
     np.testing.assert_allclose(got[[0, 2]], 0.0)
+
+
+class TestSparseDot:
+    """True sparse dot (reference: tensor/dot-inl.h) vs dense oracle."""
+
+    def test_csr_dot_dense(self):
+        from mxnet_tpu.ndarray import sparse as sp
+        rng = np.random.RandomState(0)
+        dense = (rng.rand(5, 7) < 0.4) * rng.randn(5, 7)
+        dense = dense.astype("f")
+        W = rng.randn(7, 3).astype("f")
+        # build CSR by hand
+        vals, cols, indptr = [], [], [0]
+        for row in dense:
+            nz = np.nonzero(row)[0]
+            cols.extend(nz.tolist())
+            vals.extend(row[nz].tolist())
+            indptr.append(len(cols))
+        csr = sp.CSRNDArray(nd.array(np.array(vals, "f")),
+                            nd.array(np.array(cols, "i")),
+                            nd.array(np.array(indptr, "i")), (5, 7))
+        out = sp.dot(csr, nd.array(W))
+        np.testing.assert_allclose(out.asnumpy(), dense @ W,
+                                   rtol=1e-5, atol=1e-5)
+        outT = sp.dot(csr, nd.array(rng.randn(5, 2).astype("f")),
+                      transpose_a=True)
+        assert outT.shape == (7, 2)
+
+    def test_csr_dot_transpose_oracle(self):
+        from mxnet_tpu.ndarray import sparse as sp
+        rng = np.random.RandomState(1)
+        dense = np.zeros((4, 6), "f")
+        dense[0, 1] = 2.0
+        dense[2, 5] = -1.0
+        dense[3, 0] = 3.0
+        vals, cols, indptr = [], [], [0]
+        for row in dense:
+            nz = np.nonzero(row)[0]
+            cols.extend(nz.tolist())
+            vals.extend(row[nz].tolist())
+            indptr.append(len(cols))
+        csr = sp.CSRNDArray(nd.array(np.array(vals, "f")),
+                            nd.array(np.array(cols, "i")),
+                            nd.array(np.array(indptr, "i")), (4, 6))
+        X = rng.randn(4, 3).astype("f")
+        out = sp.dot(csr, nd.array(X), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), dense.T @ X,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_row_sparse_dot(self):
+        from mxnet_tpu.ndarray import sparse as sp
+        from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+        rng = np.random.RandomState(2)
+        vals = rng.randn(2, 4).astype("f")
+        rsp = RowSparseNDArray(nd.array(vals),
+                               nd.array(np.array([1, 3], "i")), (5, 4))
+        W = rng.randn(4, 3).astype("f")
+        out = sp.dot(rsp, nd.array(W))
+        ref = np.zeros((5, 4), "f")
+        ref[[1, 3]] = vals
+        np.testing.assert_allclose(out.asnumpy(), ref @ W,
+                                   rtol=1e-5, atol=1e-5)
+        outT = sp.dot(rsp, nd.array(rng.randn(5, 3).astype("f")),
+                      transpose_a=True)
+        assert outT.shape == (4, 3)
