@@ -45,8 +45,21 @@ def main():
     mesh = make_mesh({"dp": n_dev})
 
     net = vision.resnet50_v1(classes=1000, layout="NHWC")
-    net.initialize()
-    net(mx.nd.zeros((1, IMG, IMG, 3)))  # materialize shapes
+    # materialize parameters WITHOUT an eager forward (which would
+    # trigger ~180 separate accelerator compiles over the device link):
+    # symbolic shape inference + deferred-init finish. Prefer the host
+    # CPU backend for the initializer ops when it exists (it is absent
+    # under JAX_PLATFORMS=axon/tpu-only configurations).
+    import contextlib
+    try:
+        mat_ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+    except Exception:
+        mat_ctx = contextlib.nullcontext()
+    with mat_ctx:
+        net.initialize()
+        net.infer_shape(mx.nd.zeros((1, IMG, IMG, 3)))
+        for p in net.collect_params().values():
+            p._finish_deferred_init()
 
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",

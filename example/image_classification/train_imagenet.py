@@ -54,10 +54,17 @@ def main():
     nhwc = args.layout == "NHWC"
     net = getattr(vision, args.network)(classes=args.num_classes,
                                         layout=args.layout)
-    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+    import contextlib
+    try:
+        mat_ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+    except Exception:
+        mat_ctx = contextlib.nullcontext()
+    with mat_ctx:
         net.initialize()
         shape = (1, h, w, c) if nhwc else (1, c, h, w)
-        net(mx.nd.zeros(shape))
+        net.infer_shape(mx.nd.zeros(shape))
+        for p in net.collect_params().values():
+            p._finish_deferred_init()
 
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     st = ShardedTrainer(
