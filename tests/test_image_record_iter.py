@@ -127,7 +127,6 @@ def test_record_iter_feeds_sharded_trainer(rec_path):
     """End-to-end: record file -> threaded iterator (NHWC) -> fused
     ShardedTrainer step on the 8-device mesh (the train_imagenet.py
     composition, minimized)."""
-    import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon import nn as gnn
     from mxnet_tpu.parallel import make_mesh, ShardedTrainer
