@@ -474,15 +474,23 @@ class LibSVMIter(DataIter):
             data_shape, int) else int(data_shape)
         self._rows, self._labels = self._parse(data_libsvm,
                                                self._feat_dim)
+        self._label_dim = 1
         if label_libsvm:
             ldim = int(label_shape[0]) if label_shape else 1
             lrows, _ = self._parse(label_libsvm, ldim)
-            self._labels = [r.todense().asnumpy() if hasattr(r, "todense")
-                            else r for r in lrows]
+            dense_labels = []
+            for idxs, vals in lrows:
+                row = np.zeros((ldim,), np.float32)
+                row[idxs] = vals
+                dense_labels.append(row)
+            self._labels = dense_labels
+            self._label_dim = ldim
         self._round_batch = round_batch
         self.provide_data = [DataDesc(data_name,
                                       (batch_size, self._feat_dim))]
-        self.provide_label = [DataDesc(label_name, (batch_size,))]
+        lshape = (batch_size,) if self._label_dim == 1 \
+            else (batch_size, self._label_dim)
+        self.provide_label = [DataDesc(label_name, lshape)]
         self._cur = 0
 
     @staticmethod

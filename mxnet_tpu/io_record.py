@@ -106,8 +106,10 @@ class ImageRecordIter(DataIter):
             self._loader = _PyRecordChunker(self._path, self.batch_size)
         self._q = queue.Queue(self._depth)
         self._stop = threading.Event()
-        self._assembler = threading.Thread(target=self._assemble,
-                                           daemon=True)
+        self._exhausted = False
+        self._assembler = threading.Thread(
+            target=self._assemble, args=(self._q, self._stop, self._loader),
+            daemon=True)
         self._assembler.start()
 
     def _decode_one(self, raw):
@@ -125,11 +127,14 @@ class ImageRecordIter(DataIter):
             lbl = lbl[:self._label_width]
         return x, lbl
 
-    def _assemble(self):
+    def _assemble(self, q, stop, loader):
+        # q/stop/loader arrive as arguments: a reset() that times out
+        # waiting for this thread must not let it touch the NEW epoch's
+        # queue through self
         carry = []
         try:
-            for records in self._loader:
-                if self._stop.is_set():
+            for records in loader:
+                if stop.is_set():
                     return
                 records = list(records)
                 if self._shuffle:
@@ -139,21 +144,22 @@ class ImageRecordIter(DataIter):
                 while len(samples) >= self.batch_size:
                     chunk, samples = (samples[:self.batch_size],
                                       samples[self.batch_size:])
-                    self._put(self._collate(chunk, pad=0))
+                    self._put(q, stop, self._collate(chunk, pad=0))
                 carry = samples
             if carry and self._round_batch:
                 pad = self.batch_size - len(carry)
                 carry = carry + [carry[-1]] * pad
-                self._put(self._collate(carry, pad=pad))
+                self._put(q, stop, self._collate(carry, pad=pad))
         except Exception as e:  # surface in next()
-            self._put(e)
+            self._put(q, stop, e)
             return
-        self._put(None)
+        self._put(q, stop, None)
 
-    def _put(self, item):
-        while not self._stop.is_set():
+    @staticmethod
+    def _put(q, stop, item):
+        while not stop.is_set():
             try:
-                self._q.put(item, timeout=0.1)
+                q.put(item, timeout=0.1)
                 return
             except queue.Full:
                 continue
@@ -170,10 +176,15 @@ class ImageRecordIter(DataIter):
 
     # -- DataIter protocol ---------------------------------------------
     def next(self):
+        if self._exhausted:
+            raise StopIteration  # repeatedly, like the reference; a
+            # blocking get() here would deadlock (no producer alive)
         item = self._q.get()
         if item is None:
+            self._exhausted = True
             raise StopIteration
         if isinstance(item, Exception):
+            self._exhausted = True
             raise item
         return item
 

@@ -580,12 +580,9 @@ def waitall():
     for arr in list(_live_arrays):
         data = arr._data
         if isinstance(data, jax.Array):
-            try:
-                data.block_until_ready()
-            except Exception:
-                # deleted/donated buffers: their producing computation has
-                # necessarily completed
-                pass
+            if getattr(data, "is_deleted", lambda: False)():
+                continue  # donated buffer: its producer has completed
+            data.block_until_ready()
     jax.effects_barrier()
     from .. import engine as _engine
     _engine._waitall_native()

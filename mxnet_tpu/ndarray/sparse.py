@@ -246,11 +246,14 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         w = rhs._data.T if transpose_b else rhs._data
         vals = lhs.data._data
         cols = lhs.indices._data.astype(jnp.int32)
-        indptr = np.asarray(lhs.indptr._data)
+        indptr = lhs.indptr._data.astype(jnp.int32)
         n_rows = lhs.shape[0]
-        row_ids = jnp.asarray(
-            np.repeat(np.arange(n_rows, dtype=np.int32),
-                      np.diff(indptr)))
+        # device-side row ids (no host round-trip; keeps dispatch async):
+        # row of nnz p = number of indptr entries (past the leading 0)
+        # that are <= p
+        nnz = vals.shape[0]
+        row_ids = jnp.searchsorted(indptr[1:], jnp.arange(nnz),
+                                   side="right").astype(jnp.int32)
         if not transpose_a:
             # (N, D) x (D, K): contrib[p] = vals[p] * W[cols[p]]
             contrib = vals[:, None] * jnp.take(w, cols, axis=0)
@@ -265,7 +268,7 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     if isinstance(lhs, RowSparseNDArray):
         vals = lhs.data._data
         idx = lhs.indices._data.astype(jnp.int32)
-        w = rhs._data
+        w = rhs._data.T if transpose_b else rhs._data
         if not transpose_a:
             # (N, D) x (D, K): only stored rows contribute rows of out
             rows = vals @ w

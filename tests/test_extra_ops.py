@@ -193,3 +193,21 @@ class TestLibSVMIter:
             it.next()
         it.reset()
         assert it.next().label[0].asnumpy()[0] == 1
+
+
+def test_libsvm_label_file(tmp_path):
+    """Separate label_libsvm file -> dense multi-label vectors
+    (reference: iter_libsvm.cc label path)."""
+    d = tmp_path / "d.libsvm"
+    d.write_text("0 0:1.0\n0 1:2.0\n")
+    l = tmp_path / "l.libsvm"
+    l.write_text("0 0:1.0 2:0.5\n0 1:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(d), data_shape=(3,),
+                          label_libsvm=str(l), label_shape=(3,),
+                          batch_size=2)
+    b = it.next()
+    lbl = b.label[0].asnumpy()
+    assert lbl.shape == (2, 3)
+    np.testing.assert_allclose(lbl[0], [1.0, 0, 0.5])
+    np.testing.assert_allclose(lbl[1], [0, 1.0, 0])
+    assert it.provide_label[0].shape == (2, 3)

@@ -152,3 +152,14 @@ def test_record_iter_feeds_sharded_trainer(rec_path):
     assert n == 2  # 23 records -> 2 full batches of 8
     assert np.isfinite(float(l.asnumpy()))
     it.close()
+
+
+def test_exhausted_iter_raises_stopiteration_repeatedly(rec_path):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, H, W),
+                               batch_size=8, round_batch=False,
+                               preprocess_threads=2)
+    list(it)
+    for _ in range(3):  # must not deadlock
+        with pytest.raises(StopIteration):
+            it.next()
+    it.close()
