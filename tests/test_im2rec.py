@@ -1,0 +1,82 @@
+"""tools/im2rec.py end-to-end: folder -> .lst -> .rec -> ImageRecordIter.
+
+Reference: tools/im2rec.py (list + pack), consumed by
+iter_image_recordio_2.cc.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+from PIL import Image
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_im2rec_roundtrip(tmp_path):
+    root = tmp_path / "images"
+    for ci, cls in enumerate(["cat", "dog"]):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = np.full((10, 11, 3), ci * 100 + i, np.uint8)
+            Image.fromarray(arr).save(d / ("%d.png" % i))
+    prefix = str(tmp_path / "data")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    tool = os.path.join(REPO, "tools", "im2rec.py")
+    r = subprocess.run([sys.executable, tool, "--list", prefix, str(root)],
+                       capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".lst")
+    r = subprocess.run([sys.executable, tool, prefix, str(root),
+                        "--encoding", ".png"],
+                       capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 10, 11), batch_size=3,
+                               round_batch=False, preprocess_threads=2)
+    labels = []
+    for b in it:
+        labels.extend(b.label[0].asnumpy().tolist())
+        x = b.data[0].asnumpy()
+        # pixel value encodes class*100+i; label must match class
+        for s in range(x.shape[0]):
+            cls = int(labels[-x.shape[0] + s])
+            assert abs(x[s].mean() - (cls * 100 + x[s].mean() % 100)) < 3
+    assert sorted(labels) == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+    it.close()
+
+
+def test_python_chunker_fallback(tmp_path, monkeypatch):
+    """ImageRecordIter must work without the native lib (portable
+    _PyRecordChunker path)."""
+    from mxnet_tpu import recordio as rio
+    from mxnet_tpu import io_record
+
+    path = str(tmp_path / "f.rec")
+    w = rio.MXRecordIO(path, "w")
+    for i in range(5):
+        img = np.full((6, 6, 3), i * 20, np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                             img_fmt=".png"))
+    w.close()
+
+    from mxnet_tpu import _native
+
+    def broken_loader(*a, **k):
+        raise _native.NativeError("forced fallback")
+
+    monkeypatch.setattr(_native, "PrefetchLoader", broken_loader)
+    it = io_record.ImageRecordIter(path_imgrec=path, data_shape=(3, 6, 6),
+                                   batch_size=2, round_batch=False,
+                                   preprocess_threads=1)
+    got = [int(v) for b in it for v in b.label[0].asnumpy()]
+    assert got == [0, 1, 2, 3]
+    it.close()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / list file into a RecordIO .rec (+.idx).
+
+Reference: tools/im2rec.py (list generation + multiprocess packing).
+This version packs with mxnet_tpu.recordio (same container format the
+C++ PrefetchLoader reads) using a thread pool for encode parallelism.
+
+Usage:
+  # 1) make a list (label = folder index, like the reference --list)
+  python tools/im2rec.py --list prefix image_root
+  # 2) pack it
+  python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, train_ratio=1.0, shuffle=True, seed=0):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    for ci, cls in enumerate(classes):
+        for dirpath, _, files in os.walk(os.path.join(root, cls)):
+            for f in sorted(files):
+                if f.lower().endswith(EXTS):
+                    rel = os.path.relpath(os.path.join(dirpath, f), root)
+                    entries.append((float(ci), rel))
+    if shuffle:
+        random.Random(seed).shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    chunks = [("", entries[:n_train])]
+    if n_train < len(entries):
+        chunks = [("_train", entries[:n_train]),
+                  ("_val", entries[n_train:])]
+    for suffix, chunk in chunks:
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, (lbl, rel) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, lbl, rel))
+    print("wrote %d entries over %d classes" % (len(entries), len(classes)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, num_threads=4,
+         color=1, encoding=".jpg"):
+    from mxnet_tpu import recordio as rio
+    from PIL import Image
+
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        raise SystemExit("list file %s not found (run --list first)" % lst)
+
+    def encode(item):
+        idx, labels, rel = item
+        img = Image.open(os.path.join(root, rel))
+        img = img.convert("RGB" if color else "L")
+        if resize:
+            w, h = img.size
+            s = resize / min(w, h)
+            img = img.resize((max(1, int(w * s)), max(1, int(h * s))),
+                             Image.BILINEAR)
+        arr = np.asarray(img)
+        label = labels[0] if len(labels) == 1 else np.asarray(
+            labels, np.float32)
+        header = rio.IRHeader(0, label, idx, 0)
+        return idx, rio.pack_img(header, arr, quality=quality,
+                                 img_fmt=encoding)
+
+    writer = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with ThreadPoolExecutor(num_threads) as pool:
+        for idx, rec in pool.map(encode, read_list(lst)):
+            writer.write_idx(idx, rec)
+            n += 1
+            if n % 1000 == 0:
+                print("packed %d" % n)
+    writer.close()
+    print("wrote %s.rec (%d records)" % (prefix, n))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst instead of packing")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--num-threads", type=int, default=4)
+    p.add_argument("--encoding", default=".jpg")
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, args.train_ratio,
+                  not args.no_shuffle)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, num_threads=args.num_threads,
+             encoding=args.encoding)
+
+
+if __name__ == "__main__":
+    main()
