@@ -208,3 +208,57 @@ class TestCrop:
         like = nd.zeros((2, 1, 4, 4))
         y2 = nd.Crop(nd.array(x), like, offset=(0, 0), num_args=2)
         assert y2.shape == (2, 1, 4, 4)
+
+
+class TestDeformablePSROI:
+    def test_no_trans_averages_bins(self):
+        # constant-per-channel input: every bin's average = channel value
+        p, out_dim = 2, 1
+        C = out_dim * p * p
+        x = np.zeros((1, C, 8, 8), "f")
+        for c in range(C):
+            x[0, c] = c + 1
+        rois = np.array([[0, 0, 0, 7, 7]], "f")
+        y = nd.contrib.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), spatial_scale=1.0,
+            output_dim=out_dim, group_size=p, pooled_size=p,
+            sample_per_part=2, no_trans=True)
+        got = y.asnumpy()[0, 0]
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]], atol=0.2)
+
+    def test_zero_trans_matches_no_trans(self):
+        rng = np.random.RandomState(0)
+        p, out_dim = 2, 2
+        C = out_dim * p * p
+        x = rng.randn(1, C, 8, 8).astype("f")
+        rois = np.array([[0, 1, 1, 6, 6]], "f")
+        trans = np.zeros((1, 2, p, p), "f")
+        y1 = nd.contrib.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), spatial_scale=1.0,
+            output_dim=out_dim, group_size=p, pooled_size=p,
+            sample_per_part=2, no_trans=True)
+        y2 = nd.contrib.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), nd.array(trans),
+            spatial_scale=1.0, output_dim=out_dim, group_size=p,
+            pooled_size=p, sample_per_part=2, trans_std=0.1,
+            no_trans=False)
+        np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSamplerPadding:
+    def test_bilinear_sampler_zero_outside(self):
+        x = np.ones((1, 1, 4, 4), "f")
+        # grid entirely outside [-1,1] -> zeros
+        grid = np.full((1, 2, 2, 2), 3.0, "f")
+        y = nd.BilinearSampler(nd.array(x), nd.array(grid))
+        np.testing.assert_allclose(y.asnumpy(), 0.0)
+
+    def test_bilinear_sampler_edge_blend(self):
+        x = np.ones((1, 1, 2, 2), "f")
+        # exactly on the boundary samples full value
+        grid = np.zeros((1, 2, 1, 1), "f")
+        grid[0, 0] = 1.0   # x = right edge
+        grid[0, 1] = -1.0  # y = top edge
+        y = nd.BilinearSampler(nd.array(x), nd.array(grid))
+        assert y.asnumpy()[0, 0, 0, 0] == pytest.approx(1.0, abs=1e-6)
