@@ -193,8 +193,8 @@ class Conv2DTranspose(_Conv):
                  in_channels=0, **kwargs):
         kernel_size = _to_tuple(kernel_size, 2)
         output_padding = _to_tuple(output_padding, 2)
-        assert layout in ("NCHW", "NHWC"), \
-            "Only supports 'NCHW' and 'NHWC' layout for now"
+        assert layout == "NCHW", \
+            "Conv2DTranspose only supports 'NCHW' layout"
         super().__init__(
             channels, kernel_size, strides, padding, dilation, groups,
             layout, in_channels, activation, use_bias, weight_initializer,
@@ -213,8 +213,8 @@ class Conv3DTranspose(_Conv):
                  bias_initializer="zeros", in_channels=0, **kwargs):
         kernel_size = _to_tuple(kernel_size, 3)
         output_padding = _to_tuple(output_padding, 3)
-        assert layout in ("NCDHW", "NDHWC"), \
-            "Only supports 'NCDHW' and 'NDHWC' layout for now"
+        assert layout == "NCDHW", \
+            "Conv3DTranspose only supports 'NCDHW' layout"
         super().__init__(
             channels, kernel_size, strides, padding, dilation, groups,
             layout, in_channels, activation, use_bias, weight_initializer,

@@ -331,6 +331,11 @@ def _deconvolution(data, weight, *rest, kernel, num_filter, stride=None,
     dilate = tuple_param(dilate, nd) or (1,) * nd
     pad = tuple_param(pad, nd) or (0,) * nd
     adj = tuple_param(adj, nd) or (0,) * nd
+    if is_channels_last(layout):
+        raise MXNetError(
+            "Deconvolution: channels-last layouts not supported; use "
+            "NC+spatial (the NHWC weight convention for transposed "
+            "convolution is unspecified in the reference)")
     lhs_spec, _, out_spec = _conv_dim_numbers(nd, layout)
     # grad-of-conv formulation: with transpose_kernel=True the kernel is
     # given in the matching FORWARD conv's layout; the reference's weight
