@@ -1,6 +1,8 @@
 """Inception V3 model.
 
 Reference: python/mxnet/gluon/model_zoo/vision/inception.py.
+Pass layout="NHWC" for the channels-last (MXU-native) variant; feed
+data as (N, H, W, C). Branch concatenation then runs on the last axis.
 """
 from __future__ import annotations
 
@@ -11,27 +13,33 @@ from ... import nn
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
+def _bn_axis(layout):
+    from ....ops.nn import channel_axis
+    return channel_axis(layout, len(layout))
+
+
+def _make_basic_conv(layout="NCHW", **kwargs):
     out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Conv2D(use_bias=False, layout=layout, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001, axis=_bn_axis(layout)))
     out.add(nn.Activation("relu"))
     return out
 
 
-def _make_branch(use_pool, *conv_settings):
+def _make_branch(use_pool, layout, *conv_settings):
     out = nn.HybridSequential(prefix="")
     if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1,
+                             layout=layout))
     elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+        out.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
     setting_names = ["channels", "kernel_size", "strides", "padding"]
     for setting in conv_settings:
         kwargs = {}
         for i, value in enumerate(setting):
             if value is not None:
                 kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
+        out.add(_make_basic_conv(layout=layout, **kwargs))
     return out
 
 
@@ -52,103 +60,107 @@ class _Concurrent(HybridBlock):
         return F.concat(*outs, dim=self._axis)
 
 
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
+def _make_A(pool_features, prefix, layout):
+    out = _Concurrent(axis=_bn_axis(layout), prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None),
+        out.add(_make_branch(None, layout, (64, 1, None, None)))
+        out.add(_make_branch(None, layout, (48, 1, None, None),
                              (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None),
+        out.add(_make_branch(None, layout, (64, 1, None, None),
                              (96, 3, None, 1), (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
+        out.add(_make_branch("avg", layout, (pool_features, 1, None, None)))
     return out
 
 
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
+def _make_B(prefix, layout):
+    out = _Concurrent(axis=_bn_axis(layout), prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None),
+        out.add(_make_branch(None, layout, (384, 3, 2, None)))
+        out.add(_make_branch(None, layout, (64, 1, None, None),
                              (96, 3, None, 1), (96, 3, 2, None)))
-        out.add(_make_branch("max"))
+        out.add(_make_branch("max", layout))
     return out
 
 
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
+def _make_C(channels_7x7, prefix, layout):
+    out = _Concurrent(axis=_bn_axis(layout), prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
+        out.add(_make_branch(None, layout, (192, 1, None, None)))
+        out.add(_make_branch(None, layout, (channels_7x7, 1, None, None),
                              (channels_7x7, (1, 7), None, (0, 3)),
                              (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
+        out.add(_make_branch(None, layout, (channels_7x7, 1, None, None),
                              (channels_7x7, (7, 1), None, (3, 0)),
                              (channels_7x7, (1, 7), None, (0, 3)),
                              (channels_7x7, (7, 1), None, (3, 0)),
                              (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
+        out.add(_make_branch("avg", layout, (192, 1, None, None)))
     return out
 
 
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
+def _make_D(prefix, layout):
+    out = _Concurrent(axis=_bn_axis(layout), prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None),
+        out.add(_make_branch(None, layout, (192, 1, None, None),
                              (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
+        out.add(_make_branch(None, layout, (192, 1, None, None),
                              (192, (1, 7), None, (0, 3)),
                              (192, (7, 1), None, (3, 0)),
                              (192, 3, 2, None)))
-        out.add(_make_branch("max"))
+        out.add(_make_branch("max", layout))
     return out
 
 
 class _BranchSplit(HybridBlock):
     """Two parallel convs concatenated (used inside E blocks)."""
 
-    def __init__(self, settings, prefix=None):
+    def __init__(self, settings, layout="NCHW", prefix=None):
         super().__init__(prefix=prefix)
-        self.paths = _Concurrent(prefix="")
+        self.paths = _Concurrent(axis=_bn_axis(layout), prefix="")
         for s in settings:
             self.paths.add(_make_basic_conv(
-                channels=s[0], kernel_size=s[1], padding=s[2]))
+                channels=s[0], kernel_size=s[1], padding=s[2],
+                layout=layout))
 
     def hybrid_forward(self, F, x):
         return self.paths(x)
 
 
 class _EBranch(HybridBlock):
-    def __init__(self, head_settings, split_settings, prefix=None):
+    def __init__(self, head_settings, split_settings, layout="NCHW",
+                 prefix=None):
         super().__init__(prefix=prefix)
         self.head = nn.HybridSequential(prefix="")
         for s in head_settings:
             kwargs = {"channels": s[0], "kernel_size": s[1]}
             if s[2] is not None:
                 kwargs["padding"] = s[2]
-            self.head.add(_make_basic_conv(**kwargs))
-        self.split = _BranchSplit(split_settings, prefix="")
+            self.head.add(_make_basic_conv(layout=layout, **kwargs))
+        self.split = _BranchSplit(split_settings, layout=layout, prefix="")
 
     def hybrid_forward(self, F, x):
         return self.split(self.head(x))
 
 
-def _make_E(prefix):
-    out = _Concurrent(prefix=prefix)
+def _make_E(prefix, layout):
+    out = _Concurrent(axis=_bn_axis(layout), prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
+        out.add(_make_branch(None, layout, (320, 1, None, None)))
         out.add(_EBranch([(384, 1, None)],
-                         [(384, (1, 3), (0, 1)), (384, (3, 1), (1, 0))]))
+                         [(384, (1, 3), (0, 1)), (384, (3, 1), (1, 0))],
+                         layout=layout))
         out.add(_EBranch([(448, 1, None), (384, 3, 1)],
-                         [(384, (1, 3), (0, 1)), (384, (3, 1), (1, 0))]))
-        out.add(_make_branch("avg", (192, 1, None, None)))
+                         [(384, (1, 3), (0, 1)), (384, (3, 1), (1, 0))],
+                         layout=layout))
+        out.add(_make_branch("avg", layout, (192, 1, None, None)))
     return out
 
 
-def make_aux(classes):
+def make_aux(classes, layout="NCHW"):
     out = nn.HybridSequential(prefix="")
-    out.add(nn.AvgPool2D(pool_size=5, strides=3))
-    out.add(_make_basic_conv(channels=128, kernel_size=1))
-    out.add(_make_basic_conv(channels=768, kernel_size=5))
+    out.add(nn.AvgPool2D(pool_size=5, strides=3, layout=layout))
+    out.add(_make_basic_conv(channels=128, kernel_size=1, layout=layout))
+    out.add(_make_basic_conv(channels=768, kernel_size=5, layout=layout))
     out.add(nn.Flatten())
     out.add(nn.Dense(classes))
     return out
@@ -157,32 +169,37 @@ def make_aux(classes):
 class Inception3(HybridBlock):
     """Inception v3 (reference: inception.py:141)."""
 
-    def __init__(self, classes=1000, **kwargs):
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        lo = layout
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+                                               strides=2, layout=lo))
+            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                               layout=lo))
             self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+                                               padding=1, layout=lo))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           layout=lo))
+            self.features.add(_make_basic_conv(channels=80, kernel_size=1,
+                                               layout=lo))
             self.features.add(_make_basic_conv(channels=192,
-                                               kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
+                                               kernel_size=3, layout=lo))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           layout=lo))
+            self.features.add(_make_A(32, "A1_", lo))
+            self.features.add(_make_A(64, "A2_", lo))
+            self.features.add(_make_A(64, "A3_", lo))
+            self.features.add(_make_B("B_", lo))
+            self.features.add(_make_C(128, "C1_", lo))
+            self.features.add(_make_C(160, "C2_", lo))
+            self.features.add(_make_C(160, "C3_", lo))
+            self.features.add(_make_C(192, "C4_", lo))
+            self.features.add(_make_D("D_", lo))
+            self.features.add(_make_E("E1_", lo))
+            self.features.add(_make_E("E2_", lo))
+            self.features.add(nn.AvgPool2D(pool_size=8, layout=lo))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
