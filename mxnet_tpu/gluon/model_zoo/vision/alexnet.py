@@ -14,24 +14,24 @@ __all__ = ["AlexNet", "alexnet"]
 class AlexNet(HybridBlock):
     """AlexNet (reference: alexnet.py:31)."""
 
-    def __init__(self, classes=1000, **kwargs):
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
+                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4, layout=layout,
                                             padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
+                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2, layout=layout,
                                             activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
+                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1, layout=layout,
                                             activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1, layout=layout,
                                             activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1, layout=layout,
                                             activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
                 self.features.add(nn.Flatten())
                 self.features.add(nn.Dense(4096, activation="relu"))
                 self.features.add(nn.Dropout(0.5))

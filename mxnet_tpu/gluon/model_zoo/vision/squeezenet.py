@@ -11,75 +11,88 @@ from ... import nn
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+def _cax(layout):
+    from ....ops.nn import channel_axis
+    return channel_axis(layout, len(layout))
+
+
+def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels,
+               layout="NCHW"):
     out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireConcat(expand1x1_channels, expand3x3_channels)
+    out.add(_make_fire_conv(squeeze_channels, 1, layout=layout))
+    paths = _FireConcat(expand1x1_channels, expand3x3_channels,
+                        layout=layout)
     out.add(paths)
     return out
 
 
-def _make_fire_conv(channels, kernel_size, padding=0):
+def _make_fire_conv(channels, kernel_size, padding=0, layout="NCHW"):
     out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
+    out.add(nn.Conv2D(channels, kernel_size, padding=padding,
+                      layout=layout))
     out.add(nn.Activation("relu"))
     return out
 
 
 class _FireConcat(HybridBlock):
-    def __init__(self, c1, c3, **kwargs):
+    def __init__(self, c1, c3, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self.p1 = _make_fire_conv(c1, 1)
-        self.p3 = _make_fire_conv(c3, 3, 1)
+        self._cax_v = _cax(layout)
+        self.p1 = _make_fire_conv(c1, 1, layout=layout)
+        self.p3 = _make_fire_conv(c3, 3, 1, layout=layout)
 
     def hybrid_forward(self, F, x):
-        return F.concat(self.p1(x), self.p3(x), dim=1)
+        return F.concat(self.p1(x), self.p3(x), dim=self._cax_v)
 
 
 class SqueezeNet(HybridBlock):
     """SqueezeNet 1.0/1.1 (reference: squeezenet.py:60)."""
 
-    def __init__(self, version, classes=1000, **kwargs):
+    def __init__(self, version, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        lo = layout
         assert version in ("1.0", "1.1"), \
             "Unsupported SqueezeNet version {version}: 1.0 or 1.1 " \
             "expected".format(version=version)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
+                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2,
+                                            layout=lo))
                 self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(16, 64, 64, layout=lo))
+                self.features.add(_make_fire(16, 64, 64, layout=lo))
+                self.features.add(_make_fire(32, 128, 128, layout=lo))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(32, 128, 128, layout=lo))
+                self.features.add(_make_fire(48, 192, 192, layout=lo))
+                self.features.add(_make_fire(48, 192, 192, layout=lo))
+                self.features.add(_make_fire(64, 256, 256, layout=lo))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(64, 256, 256, layout=lo))
             else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
+                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2,
+                                            layout=lo))
                 self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(16, 64, 64, layout=lo))
+                self.features.add(_make_fire(16, 64, 64, layout=lo))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(32, 128, 128, layout=lo))
+                self.features.add(_make_fire(32, 128, 128, layout=lo))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=lo))
+                self.features.add(_make_fire(48, 192, 192, layout=lo))
+                self.features.add(_make_fire(48, 192, 192, layout=lo))
+                self.features.add(_make_fire(64, 256, 256, layout=lo))
+                self.features.add(_make_fire(64, 256, 256, layout=lo))
             self.features.add(nn.Dropout(0.5))
 
             self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
+            self.output.add(nn.Conv2D(classes, kernel_size=1,
+                                      layout=lo))
             self.output.add(nn.Activation("relu"))
-            self.output.add(nn.GlobalAvgPool2D())
+            self.output.add(nn.GlobalAvgPool2D(layout=lo))
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):

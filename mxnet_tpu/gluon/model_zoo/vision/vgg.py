@@ -18,9 +18,10 @@ class VGG(HybridBlock):
     """VGG (reference: vgg.py:36)."""
 
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(filters)
+        self._layout = layout
         with self.name_scope():
             self.features = self._make_features(layers, filters, batch_norm)
             self.features.add(nn.Dense(
@@ -36,19 +37,22 @@ class VGG(HybridBlock):
                 bias_initializer="zeros")
 
     def _make_features(self, layers, filters, batch_norm):
+        from ....ops.nn import channel_axis
+        lo = self._layout
         featurizer = nn.HybridSequential(prefix="")
         for i, num in enumerate(layers):
             for _ in range(num):
                 featurizer.add(nn.Conv2D(
-                    filters[i], kernel_size=3, padding=1,
+                    filters[i], kernel_size=3, padding=1, layout=lo,
                     weight_initializer=Xavier(
                         rnd_type="gaussian", factor_type="out",
                         magnitude=2),
                     bias_initializer="zeros"))
                 if batch_norm:
-                    featurizer.add(nn.BatchNorm())
+                    featurizer.add(nn.BatchNorm(
+                        axis=channel_axis(lo, len(lo))))
                 featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
+            featurizer.add(nn.MaxPool2D(strides=2, layout=lo))
         return featurizer
 
     def hybrid_forward(self, F, x):
