@@ -20,3 +20,5 @@ from . import model_zoo
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
            "SymbolBlock", "Trainer", "nn", "rnn", "loss", "data",
            "model_zoo", "utils"]
+
+from . import contrib  # noqa: F401,E402

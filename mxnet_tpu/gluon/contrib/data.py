@@ -1,0 +1,30 @@
+"""Contrib data utilities.
+
+Reference: python/mxnet/gluon/contrib/data/sampler.py (IntervalSampler).
+The text datasets (WikiText etc.) require downloads; zero-egress
+environments should point gluon.data at local files instead.
+"""
+from __future__ import annotations
+
+from ..data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Samples i, i+interval, i+2*interval, ... for each offset i
+    (reference: contrib/data/sampler.py:24)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        return self._length

@@ -1,0 +1,72 @@
+"""Contrib neural-network layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py (Concurrent,
+HybridConcurrent, Identity, SparseEmbedding).
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Sequential, HybridSequential
+from ... import symbol as _sym
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+
+
+class Concurrent(Sequential):
+    """Feeds the input to every child and concatenates the outputs along
+    `axis` (reference: basic_layers.py:29)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference: basic_layers.py:62)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, e.g. as a parallel branch in
+    HybridConcurrent (reference: basic_layers.py:95)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding whose gradient is row_sparse in the reference
+    (basic_layers.py:116). The lookup is identical; the sparse gradient
+    exchange lives in the kvstore layer here (see
+    kvstore.row_sparse_pull / RowSparseNDArray push)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), init=weight_initializer,
+            dtype=dtype)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, name="fwd", **self._kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim}, {dtype})" \
+            .format(**self._kwargs)

@@ -1,0 +1,118 @@
+"""Contrib RNN cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py
+(VariationalDropoutCell, LSTMPCell).
+"""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import (ModifierCell, HybridRecurrentCell,
+                            BidirectionalCell)
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (time-invariant) dropout over a base cell: ONE mask
+    per sequence for inputs/states/outputs, resampled at reset()
+    (reference: contrib/rnn/rnn_cell.py:26; Gal & Ghahramani 2016)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        assert not drop_states or not isinstance(base_cell,
+                                                 BidirectionalCell), \
+            "BidirectionalCell doesn't support variational state dropout"
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._masks = {}
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._masks = {}
+
+    def _mask(self, F, key, like, rate):
+        if key not in self._masks:
+            # a dropout of ones IS the scaled bernoulli mask; it stays
+            # fixed for the rest of the sequence
+            self._masks[key] = F.Dropout(F.ones_like(like), p=rate)
+        return self._masks[key]
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_states:
+            states = list(states)
+            states[0] = states[0] * self._mask(F, "states", states[0],
+                                               self.drop_states)
+        if self.drop_inputs:
+            inputs = inputs * self._mask(F, "inputs", inputs,
+                                         self.drop_inputs)
+        output, states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            output = output * self._mask(F, "outputs", output,
+                                         self.drop_outputs)
+        return output, states
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projection layer on the hidden state
+    (reference: contrib/rnn/rnn_cell.py:197; Sak et al. 2014)."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        prefix = "t%d_" % self._counter
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "h2h")
+        gates = i2h + h2h
+        slices = F.SliceChannel(gates, num_outputs=4,
+                                name=prefix + "slice")
+        in_gate = F.Activation(slices[0], act_type="sigmoid")
+        forget_gate = F.Activation(slices[1], act_type="sigmoid")
+        in_trans = F.Activation(slices[2], act_type="tanh")
+        out_gate = F.Activation(slices[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        hidden = out_gate * F.Activation(next_c, act_type="tanh")
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size,
+                                  name=prefix + "out")
+        return next_r, [next_r, next_c]

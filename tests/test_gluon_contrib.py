@@ -1,0 +1,81 @@
+"""gluon.contrib tests: Concurrent/Identity/SparseEmbedding, contrib RNN
+cells, IntervalSampler.
+
+Reference: python/mxnet/gluon/contrib/{nn/basic_layers.py,
+rnn/rnn_cell.py, data/sampler.py}.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+
+def test_hybrid_concurrent_and_identity():
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3), cnn.Identity(), nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 5).astype("f"))
+    y = net(x)
+    assert y.shape == (4, 3 + 5 + 2)
+    # identity branch passes x through unchanged
+    np.testing.assert_allclose(y.asnumpy()[:, 3:8], x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_sparse_embedding_lookup_and_grad():
+    emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize()
+    x = mx.nd.array(np.array([1, 3, 1], "f"))
+    with autograd.record():
+        out = emb(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (3, 4)
+    g = emb.weight.grad().asnumpy()
+    # rows 1 (twice) and 3 touched; others zero
+    assert np.allclose(g[1], 2.0) and np.allclose(g[3], 1.0)
+    assert np.allclose(g[[0, 2, 4, 5, 6, 7, 8, 9]], 0.0)
+
+
+def test_variational_dropout_constant_mask():
+    base = gluon.rnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((2, 4))
+    states = cell.begin_state(batch_size=2)
+    with autograd.train_mode():
+        o1, states = cell(x, states)
+        o2, states = cell(x, states)
+    # the SAME output mask must apply at both steps: zeros co-located
+    z1 = o1.asnumpy() == 0
+    z2 = o2.asnumpy() == 0
+    assert (z1 == z2).all()
+    cell.reset()
+    assert cell._masks == {}
+
+
+def test_lstmp_cell_projects():
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = mx.nd.ones((2, 5))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 3)              # projected
+    assert new_states[0].shape == (2, 3)    # r state
+    assert new_states[1].shape == (2, 8)    # c state
+
+    # unrolls like any recurrent cell
+    seq = mx.nd.ones((2, 4, 5))
+    outputs, _ = cell.unroll(4, seq, merge_outputs=True)
+    assert outputs.shape == (2, 4, 3)
+
+
+def test_interval_sampler():
+    assert list(IntervalSampler(6, 2)) == [0, 2, 4, 1, 3, 5]
+    assert list(IntervalSampler(6, 2, rollover=False)) == [0, 2, 4]
+    assert len(IntervalSampler(6, 2)) == 6
