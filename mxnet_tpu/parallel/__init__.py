@@ -10,6 +10,7 @@ collectives over a named `jax.sharding.Mesh`:
 - tensor parallel:  `param_rules` PartitionSpecs on the 'tp' axis
 - pipeline:         `pipeline_apply` (ppermute stage ring)
 - sequence/context: `ring_attention` (ppermute K/V ring, online softmax)
+- expert parallel:  `moe_ffn` (top-k routed experts, all_to_all dispatch)
 - multi-host:       `DistKVStore` ('tpu_dist') over jax.distributed
 """
 from .mesh import (make_mesh, data_parallel_mesh, replicated, shard_on,
@@ -18,10 +19,12 @@ from .mesh import (make_mesh, data_parallel_mesh, replicated, shard_on,
 from .data_parallel import ShardedTrainer
 from .ring_attention import ring_attention, local_attention, RingAttention
 from .pipeline import pipeline_apply
+from .moe import moe_ffn, moe_ffn_dense, moe_gating, ExpertParallelMoE
 from .kvstore_dist import DistKVStore, init_distributed
 
 __all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
            "put_sharded", "use_mesh", "current_mesh", "Mesh",
            "NamedSharding", "PartitionSpec", "ShardedTrainer",
            "ring_attention", "local_attention", "RingAttention",
-           "pipeline_apply", "DistKVStore", "init_distributed"]
+           "pipeline_apply", "moe_ffn", "moe_ffn_dense", "moe_gating",
+           "ExpertParallelMoE", "DistKVStore", "init_distributed"]

@@ -219,7 +219,10 @@ class ShardedTrainer:
         return NamedSharding(self._mesh, PartitionSpec(*spec))
 
     # -- compiled step --------------------------------------------------
-    def _build_step(self):
+    def _make_step_body(self):
+        """The pure per-step function (params, aux, opt_state, inputs,
+        key) -> (params', aux', opt_state', loss), shared by the
+        single-step jit and the scanned multi-step program."""
         fn = self._fn
         opt_update = self._opt_update
         hp = self._opt_hp
@@ -250,20 +253,87 @@ class ShardedTrainer:
             new_aux.update(auxup or {})
             return new_params, new_aux, new_state, loss
 
+        return step
+
+    def _shardings(self):
         param_sh = {n: NamedSharding(self._mesh, self._spec_for(n))
                     for n in self._params}
         aux_sh = {n: NamedSharding(self._mesh, self._spec_for(n))
                   for n in self._aux}
         rep = replicated(self._mesh)
         opt_sh = _match_param_shardings(self._opt_state, param_sh, rep)
-        batch_sh = self._batch_sharding()
-        in_sh = {n: batch_sh for n in
-                 self._data_names + self._label_names}
+        in_sh = {n: self._batch_sharding()
+                 for n in self._data_names + self._label_names}
+        return param_sh, aux_sh, opt_sh, in_sh, rep
+
+    def _build_step(self):
+        step = self._make_step_body()
+        param_sh, aux_sh, opt_sh, in_sh, rep = self._shardings()
         self._step_fn = jax.jit(
             step,
             in_shardings=(param_sh, aux_sh, opt_sh, in_sh, None),
             out_shardings=(param_sh, aux_sh, opt_sh, rep),
             donate_argnums=(0, 1, 2))
+
+    def _build_step_many(self):
+        """K steps fused into ONE XLA program: `lax.scan` over the step
+        body, reusing the staged batch each iteration (the reference's
+        `--benchmark 1` synthetic-data mode). One dispatch per K steps —
+        on high-latency links (dev tunnels, multi-host controllers) the
+        per-call round trip amortizes away; on any TPU it removes K-1
+        host dispatches."""
+        body = self._make_step_body()
+        needs_rng = self._needs_rng
+
+        def many(params, aux, opt_state, inputs, key, n_steps, unroll):
+            def scan_body(carry, _):
+                params, aux, opt_state, key = carry
+                if needs_rng:
+                    key, sub = jax.random.split(key)
+                else:
+                    sub = None
+                params, aux, opt_state, loss = body(params, aux,
+                                                    opt_state, inputs, sub)
+                return (params, aux, opt_state, key), loss
+            (params, aux, opt_state, _), losses = lax.scan(
+                scan_body, (params, aux, opt_state, key), None,
+                length=n_steps, unroll=unroll)
+            return params, aux, opt_state, losses
+
+        param_sh, aux_sh, opt_sh, in_sh, rep = self._shardings()
+        self._step_many_fn = jax.jit(
+            many,
+            in_shardings=(param_sh, aux_sh, opt_sh, in_sh, None),
+            out_shardings=(param_sh, aux_sh, opt_sh, rep),
+            donate_argnums=(0, 1, 2), static_argnums=(5, 6))
+
+    def step_many(self, *batch_and_labels, n_steps, unroll=1):
+        """Run `n_steps` fused train steps as one jitted scan over the
+        given (single) batch; returns the per-step losses as an (n_steps,)
+        NDArray. `unroll` replicates the step body inside the scan —
+        measured ~10%% faster at 8-10 on real hardware (XLA schedules
+        across step boundaries) at the cost of compile time. Not
+        available with gradient compression (whose step carries
+        per-device residual state through shard_map)."""
+        if self._grad_compression is not None:
+            raise MXNetError("step_many: not supported with gradient "
+                             "compression; call step() per batch")
+        if getattr(self, "_step_many_fn", None) is None:
+            self._build_step_many()
+        names = self._data_names + self._label_names
+        if len(batch_and_labels) != len(names):
+            raise MXNetError("step_many expects %s" % (names,))
+        sh = self._batch_sharding()
+        inputs = {}
+        for n, x in zip(names, batch_and_labels):
+            arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            inputs[n] = jax.device_put(arr, sh)
+        key = _random.next_key() if self._needs_rng else None
+        self._params, self._aux, self._opt_state, losses = \
+            self._step_many_fn(self._params, self._aux, self._opt_state,
+                               inputs, key, int(n_steps), int(unroll))
+        self._step_count += int(n_steps)
+        return NDArray(losses)
 
     def _build_step_compressed(self):
         """Compressed-DP step: shard_map over the dp axis with an explicit

@@ -1,0 +1,168 @@
+"""Expert parallelism: Mixture-of-Experts FFN over an 'ep' mesh axis.
+
+The reference (2018) has NO expert parallelism (SURVEY.md §2.3 marks
+EP/MoE absent). This module is the modern TPU-native upgrade the task
+calls for, alongside ring attention and the pipeline ring: experts are
+sharded over a mesh axis ('ep'), tokens are routed to their top-k
+experts with a capacity limit, and the token blocks travel between
+devices via `lax.all_to_all` riding ICI — the canonical TPU MoE dataflow
+(GShard/Switch style, cf. PAPERS.md sharding papers).
+
+Dataflow inside `shard_map` (per device, E experts total over n devices):
+  tokens (N/n, D)
+    -- gate: softmax(x @ gate_w), top-k, capacity cumsum --> dispatch
+    -- einsum nd,nec -> (E, C, D) expert slots
+    -- all_to_all: (E, C, D) -> (E/n, n*C, D)   [tokens reach their expert]
+    -- local expert FFN (relu MLP) on (E/n, n*C, D)
+    -- all_to_all back: (E/n, n*C, D) -> (E, C, D)
+    -- einsum ecd,nec -> (N/n, D) weighted combine
+All shapes are static (capacity C is fixed), so the whole layer jits
+into one XLA program with two all-to-alls — no dynamic shapes, no host
+round trips.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import shard_map_compat
+
+__all__ = ["moe_gating", "moe_ffn", "moe_ffn_dense", "ExpertParallelMoE"]
+
+
+def moe_gating(x, gate_w, top_k, capacity, normalize=True):
+    """Top-k gating with a fixed per-expert capacity.
+
+    x: (N, D) tokens; gate_w: (D, E). Returns
+      dispatch: (N, E, C) 0/1 — token n occupies slot c of expert e
+      combine:  (N, E, C) float — dispatch weighted by the gate prob
+      aux:      scalar load-balance loss (E * sum_e f_e * p_e, the
+                Switch-Transformer auxiliary; 1.0 == perfectly balanced)
+
+    Tokens beyond an expert's capacity are dropped for that expert
+    (their combine weight is 0): fixed capacity is what keeps every
+    shape static for XLA. Slot priority is top-1 choices of all tokens
+    first, then top-2, ... (standard GShard ordering).
+    """
+    N, E = x.shape[0], gate_w.shape[1]
+    gates = jax.nn.softmax(
+        jnp.einsum("nd,de->ne", x, gate_w).astype(jnp.float32), axis=-1)
+    vals, idx = lax.top_k(gates, top_k)                    # (N, k)
+    if normalize:
+        vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)         # (N, k, E)
+    # slot positions: rank each (slot-major, token-minor) assignment
+    # within its expert, so slot 0 of every token outranks any slot 1
+    flat = oh.transpose(1, 0, 2).reshape(top_k * N, E)     # (k*N, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat             # 0-based rank
+    pos = pos_flat.reshape(top_k, N, E).transpose(1, 0, 2)  # (N, k, E)
+    keep = (pos < capacity) * oh                           # (N, k, E)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)               # (N, k, E, C)
+    dispatch = jnp.einsum("nke,nkec->nec", keep, slot)
+    combine = jnp.einsum("nk,nke,nkec->nec", vals, keep, slot)
+    # load-balance auxiliary: fraction routed to e (top-1) x mean prob
+    f = jnp.mean(oh[:, 0, :], axis=0)
+    p = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def _expert_mlp(xs, w1, b1, w2, b2):
+    """Per-expert 2-layer relu MLP: xs (E, C, D), w1 (E, D, H), ..."""
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xs, w1) + b1[:, None, :])
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+def moe_ffn_dense(x, gate_w, w1, b1, w2, b2, top_k=2, capacity=None,
+                  normalize=True):
+    """Single-device oracle: same routing/capacity semantics, no mesh.
+
+    capacity defaults to N (nothing dropped)."""
+    x = jnp.asarray(x, jnp.float32)
+    C = int(capacity if capacity is not None else x.shape[0])
+    dispatch, combine, aux = moe_gating(x, gate_w, top_k, C, normalize)
+    slots = jnp.einsum("nd,nec->ecd", x, dispatch)
+    y = _expert_mlp(slots, w1, b1, w2, b2)
+    return jnp.einsum("ecd,nec->nd", y, combine), aux
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh, axis_name="ep", top_k=2,
+            capacity_factor=2.0, normalize=True):
+    """Expert-parallel MoE FFN.
+
+    x: (N, D) GLOBAL tokens sharded on dim 0 over `axis_name`.
+    gate_w (D, E) replicated; expert params w1 (E, D, H), b1 (E, H),
+    w2 (E, H, D), b2 (E, D) sharded on dim 0 (experts) over `axis_name`.
+    E must be divisible by the axis size. Returns (out (N, D) sharded
+    like x, aux scalar).
+
+    Routing is computed per token shard; per-(device, expert) capacity
+    C = ceil(capacity_factor * top_k * N_local / E) bounds the slot
+    tensors. Two `lax.all_to_all` calls move (E, C, D) slot blocks so
+    each device runs only its E/n resident experts.
+    """
+    n = mesh.shape[axis_name]
+    E = gate_w.shape[1]
+    if E % n:
+        raise ValueError("moe_ffn: %d experts not divisible by %s=%d"
+                         % (E, axis_name, n))
+    N = x.shape[0]
+    if N % n:
+        raise ValueError("moe_ffn: %d tokens not divisible by %s=%d"
+                         % (N, axis_name, n))
+    C = -(-int(capacity_factor * top_k * (N // n)) // E)  # ceil, >=1
+
+    tok = P(axis_name)               # tokens / token-major tensors
+    exp = P(axis_name)               # expert-major params
+
+    def local_fn(xl, gw, w1l, b1l, w2l, b2l):
+        xf = xl.astype(jnp.float32)
+        dispatch, combine, aux = moe_gating(xf, gw, top_k, C, normalize)
+        slots = jnp.einsum("nd,nec->ecd", xf, dispatch)     # (E, C, D)
+        # tokens -> expert home devices: split experts, gather senders
+        slots = lax.all_to_all(slots, axis_name, split_axis=0,
+                               concat_axis=1, tiled=True)   # (E/n, nC, D)
+        y = _expert_mlp(slots, w1l, b1l, w2l, b2l)
+        y = lax.all_to_all(y, axis_name, split_axis=1,
+                           concat_axis=0, tiled=True)       # (E, C, D)
+        out = jnp.einsum("ecd,nec->nd", y, combine)
+        return out.astype(xl.dtype), lax.pmean(aux, axis_name)
+
+    fn = shard_map_compat(
+        local_fn, mesh,
+        (tok, P(), exp, exp, exp, exp),
+        (tok, P()))
+    return fn(x, gate_w, w1, b1, w2, b2)
+
+
+class ExpertParallelMoE:
+    """Callable wrapper binding mesh/axis/hyperparams (mirrors
+    RingAttention). Accepts NDArray or jax array inputs."""
+
+    def __init__(self, mesh, axis_name="ep", top_k=2, capacity_factor=2.0):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+
+    def __call__(self, x, gate_w, w1, b1, w2, b2):
+        from jax.sharding import NamedSharding
+        from ..ndarray import NDArray
+        unwrap = lambda a: a._data if isinstance(a, NDArray) else a
+        ax = self.axis_name
+        shard0 = NamedSharding(self.mesh, P(ax))
+        rep = NamedSharding(self.mesh, P())
+        # host/default-device arrays are re-laid onto the mesh here so
+        # plain NDArrays work; already-sharded inputs pass through free
+        put = jax.device_put
+        out, aux = moe_ffn(put(unwrap(x), shard0), put(unwrap(gate_w), rep),
+                           put(unwrap(w1), shard0), put(unwrap(b1), shard0),
+                           put(unwrap(w2), shard0), put(unwrap(b2), shard0),
+                           self.mesh, ax, self.top_k,
+                           self.capacity_factor)
+        if isinstance(x, NDArray):
+            return NDArray(out), NDArray(aux)
+        return out, aux

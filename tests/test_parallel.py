@@ -166,3 +166,35 @@ def test_put_sharded_batch():
     x = mx.nd.ones((16, 4))
     xs = put_sharded(x, shard_on(mesh, "dp", 0, 2))
     assert xs.shape == (16, 4)
+
+
+def test_step_many_matches_sequential_steps():
+    # K fused steps in one scanned program == K separate step() calls
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import gluon
+
+    def build():
+        m = gnn.HybridSequential()
+        m.add(gnn.Conv2D(4, 3, padding=1), gnn.BatchNorm(),
+              gnn.Activation("relu"), gnn.GlobalAvgPool2D(),
+              gnn.Dense(10))
+        m.initialize()
+        m(mx.nd.zeros((1, 3, 8, 8)))
+        return m
+
+    net = build()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 3, 8, 8).astype("float32")
+    y = (np.arange(16) % 10).astype("float32")
+    kw = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              mesh=mesh)
+    st1 = ShardedTrainer(net, lambda o, l: loss(o, l), **kw)
+    seq = [float(st1.step(x, y).asscalar()) for _ in range(5)]
+    for unroll in (1, 3):
+        st2 = ShardedTrainer(net, lambda o, l: loss(o, l), **kw)
+        many = st2.step_many(x, y, n_steps=5, unroll=unroll).asnumpy()
+        np.testing.assert_allclose(seq, many, rtol=1e-5, atol=1e-6)
+    assert st2._step_count == 5
