@@ -1,0 +1,99 @@
+"""Stacked autoencoder (reference: example/autoencoder/autoencoder.py —
+dense encoder/decoder trained on reconstruction, used there as the
+front-end for deep embedded clustering).
+
+Self-contained: trains on synthetic clustered data; reports
+reconstruction MSE and a cluster-separation score of the code layer
+(the property the reference's DEC pipeline relies on).
+
+Usage: python train_ae.py [--epochs 20] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--input-dim", type=int, default=32)
+    p.add_argument("--code-dim", type=int, default=4)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(0)
+    # 4 gaussian clusters embedded in input_dim dims
+    centers = rng.randn(4, args.input_dim) * 3
+    labels = rng.randint(0, 4, args.n)
+    data = (centers[labels]
+            + rng.randn(args.n, args.input_dim) * 0.5).astype("float32")
+
+    net = nn.HybridSequential(prefix="ae_")
+    with net.name_scope():
+        enc = nn.HybridSequential(prefix="enc_")
+        with enc.name_scope():
+            enc.add(nn.Dense(64, activation="relu"),
+                    nn.Dense(args.code_dim))
+        dec = nn.HybridSequential(prefix="dec_")
+        with dec.name_scope():
+            dec.add(nn.Dense(64, activation="relu"),
+                    nn.Dense(args.input_dim))
+        net.add(enc, dec)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, args.input_dim)))
+    net.hybridize()
+
+    l2 = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(data, data), batch_size=args.batch_size,
+        shuffle=True)
+    first = last = None
+    for epoch in range(args.epochs):
+        tot, cnt = 0.0, 0
+        for xb, yb in loader:
+            with autograd.record():
+                loss = l2(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            tot += float(loss.mean().asscalar()) * xb.shape[0]
+            cnt += xb.shape[0]
+        mse = tot / cnt
+        if first is None:
+            first = mse
+        last = mse
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print("epoch %3d  recon-mse %.5f" % (epoch, mse))
+
+    # cluster separation in code space: between/within distance ratio
+    codes = enc(mx.nd.array(data)).asnumpy()
+    mu = np.stack([codes[labels == k].mean(0) for k in range(4)])
+    within = np.mean([np.linalg.norm(codes[labels == k] - mu[k], axis=1).mean()
+                      for k in range(4)])
+    between = np.mean([np.linalg.norm(mu[i] - mu[j])
+                       for i in range(4) for j in range(i + 1, 4)])
+    print("final recon-mse %.5f (from %.5f); code separation %.2f"
+          % (last, first, between / max(within, 1e-9)))
+    assert last < first, "reconstruction did not improve"
+    return last, between / max(within, 1e-9)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ from . import executor
 from .executor import Executor
 from .cached_op import CachedOp
 from . import initializer
+from . import initializer as init  # reference alias: mx.init.*
 from .initializer import Xavier, Uniform, Normal  # noqa: F401
 from . import optimizer
 from . import optimizer as opt

@@ -1,0 +1,55 @@
+"""Example smoke tests (reference: tests/python/train — small end-to-end
+runs gating convergence). Each example asserts its own learning
+criterion and exits nonzero on failure; tests run them as a user would.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(rel, *argv, timeout=420):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # examples set cpu themselves via --cpu
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "example", rel), "--cpu",
+         *argv],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, "example %s failed:\n%s\n%s" % (
+        rel, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout
+
+
+def test_dcgan():
+    out = run_example("gan/dcgan.py", "--steps", "12",
+                      "--batch-size", "8")
+    assert "final loss_D" in out
+
+
+def test_autoencoder():
+    out = run_example("autoencoder/train_ae.py", "--epochs", "4",
+                      "--n", "256")
+    assert "final recon-mse" in out
+
+
+def test_matrix_factorization():
+    out = run_example("recommenders/matrix_factorization.py",
+                      "--epochs", "3", "--obs", "4096")
+    assert "final mse" in out
+
+
+def test_matrix_factorization_sharded():
+    out = run_example("recommenders/matrix_factorization.py",
+                      "--epochs", "3", "--obs", "4096", "--sharded")
+    assert "final mse" in out
+
+
+@pytest.mark.parametrize("extra", [(), ("--no-moe",)],
+                         ids=["moe", "dense"])
+def test_transformer_ring_attention(extra):
+    out = run_example("transformer/train_transformer.py",
+                      "--steps", "25", *extra)
+    assert "final nll" in out
