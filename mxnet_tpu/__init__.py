@@ -58,6 +58,7 @@ from . import visualization
 from . import visualization as viz
 from . import rtc
 from . import image
+from . import image as img  # reference alias: mx.img.*
 from .model import FeedForward
 from . import contrib
 from . import rnn

@@ -53,6 +53,22 @@ def imdecode(buf, flag=1, to_rgb=1, out=None):
     return array(img)
 
 
+def imread(filename, flag=1, to_rgb=1, out=None):
+    """Read an image file to an HWC NDArray (reference: image.py imread,
+    backed by the _cvimread op in src/io/image_io.cc)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb, out=out)
+
+
+def copyMakeBorder(src, top, bot, left, right, fill_value=0):
+    """Pad an HWC image with a constant border (reference: the
+    _cvcopyMakeBorder op, src/io/image_io.cc)."""
+    img = _np(src)
+    out = np.pad(img, ((top, bot), (left, right), (0, 0)),
+                 constant_values=fill_value)
+    return array(out)
+
+
 def imresize(src, w, h, interp=1):
     img = _np(src)
     try:

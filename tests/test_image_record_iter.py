@@ -163,3 +163,20 @@ def test_exhausted_iter_raises_stopiteration_repeatedly(rec_path):
         with pytest.raises(StopIteration):
             it.next()
     it.close()
+
+
+def test_imread_copymakeborder(tmp_path):
+    # reference: mx.image.imread (_cvimread) and _cvcopyMakeBorder
+    # (src/io/image_io.cc)
+    import mxnet_tpu as mx
+    from PIL import Image
+    f = str(tmp_path / "im.jpg")
+    Image.fromarray(np.full((8, 10, 3), 128, np.uint8)).save(f)
+    r = mx.image.imread(f)
+    assert r.shape == (8, 10, 3) and r.dtype == np.uint8
+    p = mx.img.copyMakeBorder(np.zeros((4, 6, 3), np.uint8),
+                              1, 2, 3, 4, fill_value=7)
+    assert p.shape == (7, 13, 3)
+    pn = p.asnumpy()
+    assert (pn[0] == 7).all() and (pn[-1] == 7).all()
+    assert (pn[1:-2, 3:-4] == 0).all()
