@@ -63,6 +63,12 @@ from .model import FeedForward
 from . import contrib
 from . import rnn
 from . import operator
+from . import attribute
+from .attribute import AttrScope
+from . import registry
+from . import libinfo
+from . import log
+from . import torch_bridge as torch  # dlpack interop (reference: mx.th)
 # Custom registers late — regenerate nd.*/sym.* frontends to pick it up
 ndarray._refresh_namespaces()
 symbol._refresh_namespaces()

@@ -1,0 +1,31 @@
+"""Library discovery + version (reference: python/mxnet/libinfo.py —
+find_lib_path locates libmxnet.so for the ctypes frontend)."""
+import os
+
+from .base import __version__
+
+__all__ = ["find_lib_path", "__version__"]
+
+
+def find_lib_path():
+    """Candidate paths of the native runtime library (libmxtpu.so).
+
+    Reference semantics: returns a non-empty list or raises. The
+    MXTPU_LIBRARY_PATH env var takes precedence (reference:
+    MXNET_LIBRARY_PATH)."""
+    override = os.environ.get("MXTPU_LIBRARY_PATH") or \
+        os.environ.get("MXNET_LIBRARY_PATH")
+    candidates = []
+    if override:
+        candidates.append(override)
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates += [
+        os.path.join(os.path.dirname(here), "src", "libmxtpu.so"),
+        os.path.join(here, "libmxtpu.so"),
+    ]
+    found = [p for p in candidates if os.path.exists(p)]
+    if not found:
+        raise RuntimeError(
+            "Cannot find libmxtpu.so; build it with `make -C src` "
+            "(searched %s)" % candidates)
+    return found

@@ -1,0 +1,33 @@
+"""Logging helpers (reference: python/mxnet/log.py — leveled logger with
+a compact single-line format)."""
+import logging
+import sys
+
+__all__ = ["get_logger", "DEBUG", "INFO", "WARNING", "ERROR", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Get a configured logger (reference log.py API: optional file
+    sink, idempotent per name)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_init", False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_init = True
+    return logger

@@ -1,0 +1,74 @@
+"""Generic class registry factories (reference: python/mxnet/registry.py
+— get_register_func/get_create_func/get_alias_func power the optimizer/
+initializer/metric registries and string-spec creation like
+create(Optimizer, "sgd; lr=0.1")).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """Returns register(klass, name=None) for subclasses of base_class."""
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                "can only register subclass of %s, got %s"
+                % (base_class.__name__, klass))
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+
+    register.__name__ = "register_%s" % nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Returns alias(name)(klass): register klass under an extra name."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*names):
+        def wrap(klass):
+            for n in names:
+                register(klass, n)
+            return klass
+        return wrap
+
+    alias.__name__ = "alias_%s" % nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Returns create(spec, **kwargs) building a registered instance.
+
+    Accepts a name, an instance (passthrough), or the reference's JSON
+    spec form '["name", {kwargs}]'."""
+    reg = _registry(base_class, nickname)
+
+    def create(spec, **kwargs):
+        if isinstance(spec, base_class):
+            return spec
+        if isinstance(spec, str) and spec.startswith("["):
+            name, jkw = json.loads(spec)
+            jkw.update(kwargs)
+            return create(name, **jkw)
+        key = str(spec).lower()
+        if key not in reg:
+            raise MXNetError("%s %r not registered (have %s)"
+                             % (nickname, spec, sorted(reg)))
+        return reg[key](**kwargs)
+
+    create.__name__ = "create_%s" % nickname
+    return create

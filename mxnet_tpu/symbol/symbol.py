@@ -445,7 +445,15 @@ def _apply_op(op, input_syms, params, name, aux_indices=(),
     # positions (graph.aux_var_ids), so sharing a var between graphs can't
     # reclassify it elsewhere.
     node = Node(op, inputs, params, name)
+    scoped = _scope_attrs()
+    if scoped:
+        node.attrs = dict(scoped)
     return Symbol([(node, i) for i in range(node.n_visible())])
+
+
+def _scope_attrs():
+    from ..attribute import AttrScope
+    return AttrScope.current_attrs()
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +466,8 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     """Create a variable symbol (reference: symbol.py var/Variable)."""
     if not isinstance(name, str):
         raise MXNetError("variable name must be a string")
-    attrs = dict(attr or {})
+    attrs = dict(_scope_attrs())  # AttrScope defaults; explicit attrs win
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:

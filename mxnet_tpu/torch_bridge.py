@@ -1,0 +1,53 @@
+"""PyTorch interop (reference: python/mxnet/torch.py bridged to Lua
+Torch; the 2026 equivalent is zero-copy-where-possible exchange with
+PyTorch via DLPack).
+
+    t = mx.torch.to_torch(nd_array)      # NDArray -> torch.Tensor
+    a = mx.torch.from_torch(tensor)      # torch.Tensor -> NDArray
+
+CPU tensors exchange through DLPack capsules (zero-copy when layouts
+allow); anything else falls back through numpy. Gated on torch being
+importable — the framework has no hard torch dependency.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:
+        raise MXNetError(
+            "PyTorch is not available in this environment") from e
+
+
+def to_torch(nd):
+    """NDArray -> torch.Tensor."""
+    torch = _torch()
+    if not isinstance(nd, NDArray):
+        raise MXNetError("to_torch expects an NDArray, got %r" % (nd,))
+    try:
+        # modern __dlpack__ protocol: jax arrays are dlpack providers
+        return torch.from_dlpack(nd._data)
+    except Exception:
+        return torch.from_numpy(np.array(nd.asnumpy(), copy=True))
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray."""
+    torch = _torch()
+    if not isinstance(tensor, torch.Tensor):
+        raise MXNetError("from_torch expects a torch.Tensor")
+    t = tensor.detach().contiguous()
+    try:
+        import jax.numpy as jnp
+        return NDArray(jnp.from_dlpack(t))
+    except Exception:
+        return array(np.array(t.cpu().numpy(), copy=True))

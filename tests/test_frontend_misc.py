@@ -1,0 +1,87 @@
+"""Small frontend modules: AttrScope, registry, libinfo, log, torch
+interop (reference: python/mxnet/{attribute,registry,libinfo,log,
+torch}.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+class TestAttrScope:
+    def test_scope_attaches_to_vars_and_ops(self):
+        with mx.AttrScope(ctx_group="stage1", lr_mult="0.1"):
+            w = mx.sym.var("asw")
+            z = mx.sym.var("asx") + w
+        assert w.attr("ctx_group") == "stage1"
+        assert w.attr("lr_mult") == "0.1"
+        assert z.attr("ctx_group") == "stage1"
+        assert mx.sym.var("asy").attr("ctx_group") is None
+
+    def test_nested_inner_wins_and_explicit_beats_scope(self):
+        with mx.AttrScope(a="1", b="1"):
+            with mx.AttrScope(a="2"):
+                s = mx.sym.var("asn", attr={"b": "9"})
+        assert s.attr("a") == "2" and s.attr("b") == "9"
+
+    def test_non_string_value_rejected(self):
+        with pytest.raises(ValueError):
+            mx.AttrScope(x=3)
+
+
+def test_registry_register_alias_create():
+    from mxnet_tpu.registry import (get_register_func, get_alias_func,
+                                    get_create_func)
+
+    class Thing:
+        def __init__(self, a=1):
+            self.a = a
+
+    reg = get_register_func(Thing, "thing")
+    create = get_create_func(Thing, "thing")
+
+    class Foo(Thing):
+        pass
+
+    reg(Foo)
+    get_alias_func(Thing, "thing")("other")(Foo)
+    assert isinstance(create("foo"), Foo)
+    assert create("other", a=2).a == 2
+    assert create('["foo", {"a": 5}]').a == 5
+    inst = Foo()
+    assert create(inst) is inst
+    with pytest.raises(mx.MXNetError):
+        create("nope")
+    with pytest.raises(mx.MXNetError):
+        reg(int)
+
+
+def test_libinfo_finds_native_lib():
+    paths = mx.libinfo.find_lib_path()
+    assert paths and paths[0].endswith("libmxtpu.so")
+    assert mx.libinfo.__version__
+
+
+def test_log_get_logger(tmp_path):
+    f = str(tmp_path / "x.log")
+    lg = mx.log.get_logger("mxtpu_test", filename=f, level=mx.log.INFO)
+    lg.info("hello-%d", 7)
+    for h in lg.handlers:
+        h.flush()
+    assert "hello-7" in open(f).read()
+    # idempotent: second call reuses handlers
+    assert mx.log.get_logger("mxtpu_test") is lg
+    assert len(lg.handlers) == 1
+
+
+def test_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    x = mx.nd.arange(12).reshape((3, 4))
+    t = mx.torch.to_torch(x)
+    assert isinstance(t, torch.Tensor)
+    np.testing.assert_allclose(t.numpy(), x.asnumpy())
+    back = mx.torch.from_torch(t * 2 + 1)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy() * 2 + 1)
+    with pytest.raises(mx.MXNetError):
+        mx.torch.from_torch(np.zeros(3))
