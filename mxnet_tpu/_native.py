@@ -74,7 +74,41 @@ def _bind(lib):
         ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
         ctypes.POINTER(ctypes.c_int64)]
     lib.MXTPrefetchBatchFree.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "MXTPUImdecodeJPEG"):  # absent in older builds
+        lib.MXTPUImdecodeJPEG.restype = ctypes.c_int
+        lib.MXTPUImdecodeJPEG.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTPUFreeBuf.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
     return lib
+
+
+def imdecode_jpeg(data, short_side=0):
+    """Native libjpeg decode to an RGB uint8 HWC array (src/
+    image_decode.cc; reference: the OpenCV decode in src/io/image_io.cc).
+
+    short_side > 0 decodes at the best DCT scale and bilinear-resizes so
+    min(h, w) == short_side. Returns None when the native path is
+    unavailable or the buffer isn't decodable (caller falls back)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "MXTPUImdecodeJPEG"):
+        return None
+    import numpy as np
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    h, w, c = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+    rc = lib.MXTPUImdecodeJPEG(data, len(data), int(short_side),
+                               ctypes.byref(out), ctypes.byref(h),
+                               ctypes.byref(w), ctypes.byref(c))
+    if rc != 0:
+        return None
+    try:
+        n = h.value * w.value * c.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        lib.MXTPUFreeBuf(out)
+    return arr.reshape(h.value, w.value, c.value)
 
 
 def _try_load():

@@ -269,6 +269,11 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 def _imdecode(s, iscolor=-1):
     if s[:4] == b"NPY0":
         return np.load(_pyio.BytesIO(s[4:]))
+    if iscolor != 0 and s[:2] == b"\xff\xd8":  # JPEG: native fast path
+        from ._native import imdecode_jpeg
+        img = imdecode_jpeg(bytes(s))
+        if img is not None:
+            return img
     try:
         from PIL import Image
         img = Image.open(_pyio.BytesIO(s))

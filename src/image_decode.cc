@@ -1,0 +1,149 @@
+// Native JPEG decode for the record-file image pipeline.
+//
+// Reference mapping: src/io/image_io.cc + iter_image_recordio_2.cc decode
+// JPEG via OpenCV inside N parser threads. Here the same stage is libjpeg
+// called through ctypes from the ImageRecordIter worker pool — the ctypes
+// call releases the GIL, so decode parallelism is real OS-thread
+// parallelism, and libjpeg's DCT scaling (scale_denom) lets us decode
+// directly at 1/2, 1/4, 1/8 resolution when the consumer only needs a
+// small short side (the dominant ImageNet case: 224 from ~500px JPEGs).
+//
+// C ABI (used by mxnet_tpu/_native.py):
+//   MXTPUImdecodeJPEG(buf, len, short_side, &out, &h, &w, &c)
+//     short_side <= 0: full-resolution decode.
+//     short_side  > 0: decode at the smallest DCT scale whose short side
+//                      is still >= short_side, then bilinear-resize so
+//                      min(h, w) == short_side (aspect preserved).
+//   Output is tightly-packed RGB (c == 3), malloc'd; free with
+//   MXTPUFreeBuf.
+#include <csetjmp>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, err->msg);
+  longjmp(err->jump, 1);
+}
+
+void on_emit(j_common_ptr, int) {}  // swallow warnings
+
+// bilinear uint8 HWC resize (the reference's cv::resize role)
+void resize_bilinear(const unsigned char* src, int sh, int sw,
+                     unsigned char* dst, int dh, int dw, int c) {
+  const float ys = dh > 1 ? float(sh - 1) / float(dh - 1) : 0.f;
+  const float xs = dw > 1 ? float(sw - 1) / float(dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y * ys;
+    const int y0 = int(fy);
+    const int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = x * xs;
+      const int x0 = int(fx);
+      const int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      const float wx = fx - x0;
+      const unsigned char* p00 = src + (y0 * sw + x0) * c;
+      const unsigned char* p01 = src + (y0 * sw + x1) * c;
+      const unsigned char* p10 = src + (y1 * sw + x0) * c;
+      const unsigned char* p11 = src + (y1 * sw + x1) * c;
+      unsigned char* d = dst + (y * dw + x) * c;
+      for (int k = 0; k < c; ++k) {
+        const float top = p00[k] + (p01[k] - p00[k]) * wx;
+        const float bot = p10[k] + (p11[k] - p10[k]) * wx;
+        d[k] = static_cast<unsigned char>(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void MXTPUFreeBuf(unsigned char* p) { std::free(p); }
+
+// returns 0 on success; -1 bad args; -2 decode error (message to stderr
+// suppressed — the python side raises from the return code)
+int MXTPUImdecodeJPEG(const unsigned char* buf, size_t len, int short_side,
+                      unsigned char** out, int* h, int* w, int* c) {
+  if (!buf || len < 4 || !out || !h || !w || !c) return -1;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  jerr.pub.emit_message = on_emit;
+  unsigned char* pixels = nullptr;
+  if (setjmp(jerr.jump)) {
+    std::free(pixels);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // grayscale/YCbCr all land as RGB
+  if (short_side > 0) {
+    // largest denom in {1,2,4,8} keeping short side >= target
+    const unsigned int s =
+        cinfo.image_width < cinfo.image_height ? cinfo.image_width
+                                               : cinfo.image_height;
+    unsigned int denom = 1;
+    while (denom < 8 && s / (denom * 2) >= (unsigned int)short_side)
+      denom *= 2;
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
+  jpeg_start_decompress(&cinfo);
+  const int sw = cinfo.output_width;
+  const int sh = cinfo.output_height;
+  const int sc = cinfo.output_components;  // 3 with JCS_RGB
+  pixels = static_cast<unsigned char*>(
+      std::malloc(static_cast<size_t>(sw) * sh * sc));
+  if (!pixels) longjmp(jerr.jump, 1);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row =
+        pixels + static_cast<size_t>(cinfo.output_scanline) * sw * sc;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  if (short_side > 0 && sw > 0 && sh > 0 &&
+      (sw < sh ? sw : sh) != short_side) {
+    const int ssd = sw < sh ? sw : sh;
+    const int dw = sw * short_side / ssd;
+    const int dh = sh * short_side / ssd;
+    unsigned char* scaled = static_cast<unsigned char*>(
+        std::malloc(static_cast<size_t>(dw) * dh * sc));
+    if (!scaled) {
+      std::free(pixels);
+      return -2;
+    }
+    resize_bilinear(pixels, sh, sw, scaled, dh, dw, sc);
+    std::free(pixels);
+    pixels = scaled;
+    *h = dh;
+    *w = dw;
+  } else {
+    *h = sh;
+    *w = sw;
+  }
+  *c = sc;
+  *out = pixels;
+  return 0;
+}
+
+}  // extern "C"

@@ -261,3 +261,29 @@ def test_naive_engine_env_selection():
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "NAIVE_OK" in r.stdout
+
+
+def test_native_jpeg_decode_matches_pil_and_scales():
+    # src/image_decode.cc (reference: the OpenCV decode in image_io.cc)
+    import io as pyio
+    pytest.importorskip("PIL")
+    from PIL import Image
+    from mxnet_tpu._native import imdecode_jpeg, ensure_built
+    if ensure_built() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.RandomState(0)
+    im = (rng.rand(96, 128, 3) * 255).astype(np.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(im).save(buf, format="JPEG", quality=92)
+    data = buf.getvalue()
+    d = imdecode_jpeg(data)
+    pil = np.asarray(Image.open(pyio.BytesIO(data)).convert("RGB"))
+    assert d is not None and d.shape == pil.shape
+    assert np.array_equal(d, pil)  # same libjpeg underneath
+    ds = imdecode_jpeg(data, short_side=48)
+    assert ds.shape == (48, 64, 3)
+    assert imdecode_jpeg(b"\xff\xd8garbage") is None
+    # grayscale jpegs come back as RGB
+    buf2 = pyio.BytesIO()
+    Image.fromarray(im[:, :, 0]).save(buf2, format="JPEG")
+    assert imdecode_jpeg(buf2.getvalue()).shape == (96, 128, 3)
