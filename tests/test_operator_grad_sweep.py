@@ -1,0 +1,96 @@
+"""Parametrized numeric-gradient sweep over the elementwise op families
+(reference: tests/python/unittest/test_operator.py's per-op checks via
+test_utils.check_numeric_gradient — the backbone of the reference's op
+test strategy, SURVEY.md §4.1).
+
+Each case: finite differences vs autograd on a small tensor drawn from
+a domain where the op is smooth (away from kinks/poles).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+# (op name, input domain (lo, hi))
+UNARY_SMOOTH = [
+    ("sigmoid", (-2, 2)), ("tanh", (-2, 2)), ("exp", (-1, 1)),
+    ("log", (0.5, 3)), ("log2", (0.5, 3)), ("log10", (0.5, 3)),
+    ("log1p", (-0.4, 2)), ("expm1", (-1, 1)), ("sqrt", (0.5, 3)),
+    ("cbrt", (0.5, 3)), ("rsqrt", (0.5, 3)), ("rcbrt", (0.5, 3)),
+    ("square", (-2, 2)), ("reciprocal", (0.5, 3)),
+    ("sin", (-2, 2)), ("cos", (-2, 2)), ("tan", (-0.5, 0.5)),
+    ("arcsin", (-0.8, 0.8)), ("arccos", (-0.8, 0.8)),
+    ("arctan", (-2, 2)), ("sinh", (-1.5, 1.5)), ("cosh", (-1.5, 1.5)),
+    ("arcsinh", (-2, 2)), ("arccosh", (1.5, 3)),
+    ("arctanh", (-0.7, 0.7)), ("erf", (-1.5, 1.5)),
+    ("gamma", (1.5, 3)), ("gammaln", (1.5, 3)),
+    ("softsign", (-2, 2)),
+]
+
+REDUCE_OPS = ["sum", "mean", "prod", "nansum", "norm"]
+
+BINARY_OPS = [
+    ("elemwise_add", (-2, 2)), ("elemwise_sub", (-2, 2)),
+    ("elemwise_mul", (-2, 2)), ("elemwise_div", (0.5, 2)),
+    ("broadcast_add", (-2, 2)), ("broadcast_mul", (-2, 2)),
+    ("broadcast_div", (0.5, 2)), ("broadcast_power", (0.5, 2)),
+    ("broadcast_hypot", (0.5, 2)),
+]
+
+
+def _rand(shape, lo, hi, seed):
+    rng = np.random.RandomState(seed)
+    return mx.nd.array(rng.uniform(lo, hi, shape).astype("float32"))
+
+
+@pytest.mark.parametrize("op,domain", UNARY_SMOOTH,
+                         ids=[o for o, _ in UNARY_SMOOTH])
+def test_unary_gradient(op, domain):
+    x = sym.var("x")
+    out = getattr(sym, op)(x)
+    data = _rand((3, 4), *domain, seed=hash(op) % 1000)
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("op", REDUCE_OPS)
+def test_reduce_gradient(op):
+    x = sym.var("x")
+    out = getattr(sym, op)(x, axis=1) if op != "norm" else sym.norm(x)
+    data = _rand((3, 4), 0.5, 2.0, seed=len(op))
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("op,domain", BINARY_OPS,
+                         ids=[o for o, _ in BINARY_OPS])
+def test_binary_gradient(op, domain):
+    a, b = sym.var("a"), sym.var("b")
+    out = getattr(sym, op)(a, b)
+    bshape = (3, 4) if not op.startswith("broadcast") else (1, 4)
+    loc = {"a": _rand((3, 4), *domain, seed=1),
+           "b": _rand(bshape, *domain, seed=2)}
+    check_numeric_gradient(out, loc, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign"])
+def test_activation_gradient(act):
+    x = sym.var("x")
+    out = sym.Activation(x, act_type=act)
+    # keep away from relu's kink at 0
+    data = _rand((3, 4), 0.3, 2.0, seed=3)
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("op", ["softmax", "log_softmax"])
+def test_softmax_gradient(op):
+    x = sym.var("x")
+    out = getattr(sym, op)(x, axis=-1)
+    data = _rand((3, 5), -2, 2, seed=4)
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
