@@ -32,6 +32,18 @@ _sys.modules[__name__ + ".linalg"] = linalg
 contrib = _types.ModuleType(__name__ + ".contrib")
 _sys.modules[__name__ + ".contrib"] = contrib
 
+# sym.sparse.*: storage-type-aware symbol ops (reference:
+# python/mxnet/symbol/sparse.py — same graph ops; storage type is an
+# attr/inference matter, not a different node kind)
+sparse = _types.ModuleType(__name__ + ".sparse")
+for _name in ("dot", "cast_storage", "elemwise_add", "elemwise_mul",
+              "zeros_like"):
+    if _name in _g:
+        sparse.__dict__[_name] = _g[_name]
+if "_sparse_retain" in _g:
+    sparse.__dict__["retain"] = _g["_sparse_retain"]
+_sys.modules[__name__ + ".sparse"] = sparse
+
 
 def _refresh_namespaces():
     _populate(_g)
