@@ -1,0 +1,106 @@
+"""Custom numpy operator (reference: example/numpy-ops/custom_softmax.py
+— the classic CustomOp tutorial: a softmax output layer written in
+numpy, registered through mx.operator, trained in a real network).
+
+Here CustomOp callbacks run via jax.pure_callback with a custom_vjp
+(mxnet_tpu/operator.py), so the numpy code participates in jitted
+graphs and autograd.
+
+Usage: python custom_softmax.py [--epochs 5] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def define_op():
+    import mxnet_tpu as mx
+
+    class Softmax(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            y = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+            self.assign(out_data[0], req[0], mx.nd.array(y))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            l = in_data[1].asnumpy().ravel().astype(np.int64)
+            y = np.array(out_data[0].asnumpy(), copy=True)
+            y[np.arange(l.shape[0]), l] -= 1.0
+            self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+    @mx.operator.register("example_softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            data_shape = in_shape[0]
+            label_shape = (in_shape[0][0],)
+            return [data_shape, label_shape], [data_shape], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Softmax()
+
+    return Softmax
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    define_op()
+
+    # two-moons-ish synthetic 10-class problem
+    rng = np.random.RandomState(0)
+    n = 2048
+    centers = rng.randn(10, 16) * 2.5
+    labels = rng.randint(0, 10, n)
+    data = (centers[labels] + rng.randn(n, 16)).astype("float32")
+
+    net = mx.sym.var("data")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.Custom(net, mx.sym.var("softmax_label"),
+                        op_type="example_softmax", name="softmax")
+
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    train = mx.io.NDArrayIter(data, labels.astype("float32"),
+                              args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    mod.fit(train, num_epoch=args.epochs,
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 20))
+    score = mod.score(train, mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    print("final train accuracy %.3f" % acc)
+    assert acc > 0.9, "custom softmax network failed to learn"
+    return acc
+
+
+if __name__ == "__main__":
+    main()

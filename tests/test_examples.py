@@ -53,3 +53,8 @@ def test_transformer_ring_attention(extra):
     out = run_example("transformer/train_transformer.py",
                       "--steps", "25", *extra)
     assert "final nll" in out
+
+
+def test_custom_softmax_numpy_op():
+    out = run_example("numpy_ops/custom_softmax.py", "--epochs", "2")
+    assert "final train accuracy" in out

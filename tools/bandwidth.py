@@ -1,0 +1,71 @@
+"""Collective-bandwidth measurement (reference: tools/bandwidth/
+measure.py — measures kvstore push+pull bus bandwidth across GPUs;
+README reports 11.1 GB/s on 2 GPUs, 4.4-4.6 GB/s on 8).
+
+Here the gradient exchange is an XLA psum over the mesh, so the tool
+times a jitted all-reduce at ResNet-50-gradient scale and reports
+algorithm bandwidth per device:
+
+    python tools/bandwidth.py [--size-mb 100] [--devices N] [--cpu]
+
+On a CPU mesh this measures memcpy-through-XLA (a correctness/plumbing
+check); on real chips the same program measures ICI.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-mb", type=float, default=100.0,
+                   help="payload per device (ResNet-50 grads ~ 100MB)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="mesh size (default: all)")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh, shard_on
+    from mxnet_tpu.parallel.mesh import shard_map_compat
+
+    n = args.devices or len(jax.devices())
+    mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    count = max(1, int(args.size_mb * 1e6 / 4))
+    x = jnp.ones((n, count), jnp.float32)
+
+    def local_fn(xl):
+        return jax.lax.psum(xl, "dp")
+
+    fn = jax.jit(shard_map_compat(local_fn, mesh, (P("dp"),), P("dp")))
+    xs = jax.device_put(x, shard_on(mesh, "dp", 0))
+    r = fn(xs)
+    float(np.asarray(jax.device_get(r[0, :1])))  # compile + fence
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        r = fn(r)
+    float(np.asarray(jax.device_get(r[0, :1])))
+    dt = (time.perf_counter() - t0) / args.iters
+    # ring-allreduce moves 2*(n-1)/n of the payload per device
+    payload = count * 4
+    algo_bw = payload * 2 * (n - 1) / n / dt
+    print("devices %d  payload/device %.1f MB  allreduce %.2f ms  "
+          "algo b/w %.2f GB/s/device"
+          % (n, payload / 1e6, dt * 1e3, algo_bw / 1e9))
+    return algo_bw
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Flakiness checker (reference: tools/flakiness_checker.py — rerun a
+test many times to estimate flake rate).
+
+    python tools/flakiness_checker.py tests/test_moe.py::test_name -n 20
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("test", help="pytest node id (file[::test])")
+    p.add_argument("-n", "--trials", type=int, default=10)
+    p.add_argument("--stop-on-fail", action="store_true")
+    args = p.parse_args()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fails = 0
+    for i in range(args.trials):
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-q", "-x"],
+            cwd=root, capture_output=True, text=True)
+        ok = r.returncode == 0
+        fails += not ok
+        print("trial %3d/%d: %s" % (i + 1, args.trials,
+                                    "PASS" if ok else "FAIL"))
+        if not ok:
+            sys.stdout.write(r.stdout[-1500:])
+            if args.stop_on_fail:
+                break
+    print("flake rate: %d/%d (%.1f%%)"
+          % (fails, args.trials, 100.0 * fails / args.trials))
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
