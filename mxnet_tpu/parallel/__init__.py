@@ -21,6 +21,7 @@ from .ring_attention import ring_attention, local_attention, RingAttention
 from .pipeline import pipeline_apply
 from .moe import moe_ffn, moe_ffn_dense, moe_gating, ExpertParallelMoE
 from .kvstore_dist import DistKVStore, init_distributed
+from . import checkpoint  # sharded/async TrainerCheckpoint (orbax)
 
 __all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
            "put_sharded", "use_mesh", "current_mesh", "Mesh",

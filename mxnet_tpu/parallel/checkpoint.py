@@ -1,0 +1,106 @@
+"""Sharded / async checkpointing for ShardedTrainer state.
+
+The reference's recovery model is "restart from checkpoint"
+(SURVEY.md §5.3-5.4: save_checkpoint/load_checkpoint write one .params
+blob from one process). That survives here for API parity
+(Module.save_checkpoint, gluon save_parameters, reference byte format).
+This module is the TPU-native upgrade SURVEY §5.4 anticipates: each
+host writes only its own shards (no gather to host 0, no 2x HBM spike),
+restore re-shards onto the current mesh, and saving can overlap the
+next training steps (async).
+
+Built on orbax (the JAX-ecosystem checkpoint library):
+
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    mngr = ckpt.TrainerCheckpoint(dir, max_to_keep=3, async_save=True)
+    mngr.save(step, trainer)           # non-blocking when async
+    step = mngr.restore_latest(trainer)  # -> restored step or None
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["TrainerCheckpoint"]
+
+
+def _state_of(trainer):
+    return {"params": dict(trainer._params),
+            "aux": dict(trainer._aux),
+            "opt_state": trainer._opt_state,
+            "step": trainer._step_count}
+
+
+class TrainerCheckpoint:
+    """Checkpoint manager for ShardedTrainer (params + aux + optimizer
+    state + step counter), sharded-aware and optionally async."""
+
+    def __init__(self, directory, max_to_keep=None, async_save=False):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._dir = os.path.abspath(str(directory))
+        os.makedirs(self._dir, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=bool(async_save))
+        self._mngr = ocp.CheckpointManager(self._dir, options=opts)
+
+    def save(self, step, trainer, wait=False):
+        """Write a checkpoint for `step`. With async_save=True this
+        returns once the on-device state is snapshotted; serialization
+        overlaps subsequent train steps (pass wait=True to block)."""
+        self._mngr.save(int(step),
+                        args=self._ocp.args.StandardSave(
+                            _state_of(trainer)))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def latest_step(self):
+        return self._mngr.latest_step()
+
+    def restore(self, step, trainer):
+        """Restore `step` into the trainer, re-sharding every leaf onto
+        the trainer's current mesh/spec (the saved mesh need not match —
+        the point of sharded restore)."""
+        self._mngr.wait_until_finished()
+        target = _state_of(trainer)
+        shardings = jax.tree.map(
+            lambda x: x.sharding if hasattr(x, "sharding") else None,
+            target)
+        restored = self._mngr.restore(
+            int(step),
+            args=self._ocp.args.StandardRestore(target))
+        restored = jax.tree.map(
+            lambda v, s: jax.device_put(v, s) if s is not None else v,
+            restored, shardings)
+        trainer._params = dict(restored["params"])
+        trainer._aux = dict(restored["aux"])
+        trainer._opt_state = restored["opt_state"]
+        trainer._step_count = int(restored["step"])
+        return trainer._step_count
+
+    def restore_latest(self, trainer):
+        """Restore the newest checkpoint; returns its step or None."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, trainer)
+
+    def wait_until_finished(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,90 @@
+"""Sharded/async checkpoint for ShardedTrainer (parallel/checkpoint.py
+— the TPU-native upgrade over the reference's single-blob
+save_checkpoint, SURVEY.md §5.4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (make_mesh, ShardedTrainer,
+                                PartitionSpec)
+from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+
+
+def _net():
+    m = nn.HybridSequential()
+    m.add(nn.Dense(16, activation="relu"), nn.Dense(10))
+    m.initialize()
+    m(mx.nd.zeros((1, 8)))
+    return m
+
+
+def _trainer(net, mesh, rules=None):
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    return ShardedTrainer(net, lambda o, l: loss(o, l), "adam",
+                          {"learning_rate": 0.01}, mesh=mesh,
+                          param_rules=rules)
+
+
+def _batch(rng):
+    return (rng.randn(16, 8).astype("float32"),
+            (np.arange(16) % 10).astype("float32"))
+
+
+def test_save_restore_resumes_identically(tmp_path):
+    rng = np.random.RandomState(0)
+    net = _net()
+    mesh = make_mesh({"dp": 8})
+    x, y = _batch(rng)
+
+    a = _trainer(net, mesh)
+    for _ in range(3):
+        a.step(x, y)
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        ck.save(a._step_count, a, wait=True)
+        after = [float(a.step(x, y).asscalar()) for _ in range(3)]
+
+        b = _trainer(net, mesh)
+        assert ck.restore_latest(b) == 3
+        resumed = [float(b.step(x, y).asscalar()) for _ in range(3)]
+    np.testing.assert_allclose(after, resumed, rtol=1e-5, atol=1e-6)
+
+
+def test_restore_onto_different_sharding(tmp_path):
+    # save from a replicated dp trainer, restore into a dp x tp trainer
+    # whose dense weights shard over 'tp' — restore must re-shard
+    rng = np.random.RandomState(1)
+    net = _net()
+    x, y = _batch(rng)
+    a = _trainer(net, make_mesh({"dp": 8}))
+    a.step(x, y)
+    with TrainerCheckpoint(tmp_path / "ck2") as ck:
+        ck.save(1, a, wait=True)
+        b = _trainer(net, make_mesh({"dp": 4, "tp": 2}),
+                     rules=[(r"dense1_weight$", PartitionSpec("tp"))])
+        ck.restore_latest(b)
+    for k in a._params:
+        np.testing.assert_allclose(np.asarray(a._params[k]),
+                                   np.asarray(b._params[k]),
+                                   rtol=1e-6, atol=1e-7)
+    la = float(a.step(x, y).asscalar())
+    lb = float(b.step(x, y).asscalar())
+    assert abs(la - lb) < 1e-4
+
+
+def test_async_save_and_max_to_keep(tmp_path):
+    rng = np.random.RandomState(2)
+    net = _net()
+    x, y = _batch(rng)
+    a = _trainer(net, make_mesh({"dp": 8}))
+    with TrainerCheckpoint(tmp_path / "ck3", max_to_keep=2,
+                           async_save=True) as ck:
+        for s in range(1, 5):
+            a.step(x, y)
+            ck.save(s, a)          # overlaps next steps
+        ck.wait_until_finished()
+        assert ck.latest_step() == 4
+        assert ck.all_steps() == [3, 4]  # pruned to max_to_keep
+        b = _trainer(net, make_mesh({"dp": 8}))
+        assert ck.restore_latest(b) == a._step_count
