@@ -1,0 +1,77 @@
+"""Environment diagnosis (reference: tools/diagnose.py — prints
+platform, versions, and connectivity so bug reports carry context).
+
+    python tools/diagnose.py
+"""
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Platform     :", platform.platform())
+    print("Processor    :", platform.processor() or "n/a")
+    print("CPU count    :", os.cpu_count())
+
+    print("----------Framework Info----------")
+    t0 = time.time()
+    import mxnet_tpu as mx
+    print("mxnet_tpu    :", mx.__version__,
+          "(import %.2fs)" % (time.time() - t0))
+    try:
+        print("native lib   :", mx.libinfo.find_lib_path()[0])
+    except Exception as e:
+        print("native lib   : NOT BUILT (%s)" % e)
+
+    print("----------JAX / Device Info----------")
+    import jax
+    import jaxlib
+    print("jax          :", jax.__version__)
+    print("jaxlib       :", jaxlib.__version__)
+    t0 = time.time()
+    # backend init can hang forever on a dead accelerator tunnel —
+    # probe from a daemon thread with a deadline
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            result["devs"] = [str(d) for d in jax.devices()]
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            result["err"] = str(e)
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout=30)
+    if th.is_alive():
+        print("devices      : TIMED OUT after 30s (backend unreachable?)")
+    elif "devs" in result:
+        print("devices      : %s (probe %.2fs)"
+              % (result["devs"], time.time() - t0))
+    else:
+        print("devices      : UNAVAILABLE (%s)" % result.get("err"))
+
+    print("----------Deps----------")
+    for name in ("numpy", "flax", "optax", "orbax.checkpoint", "PIL",
+                 "torch"):
+        try:
+            m = __import__(name)
+            print("%-12s : %s" % (name, getattr(m, "__version__", "ok")))
+        except ImportError:
+            print("%-12s : absent" % name)
+
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "DMLC_")):
+            print("%s=%s" % (k, v))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Rebuild the .idx sidecar for a RecordIO .rec file
+(reference: tools/rec2idx.py — sequential scan recording byte offsets
+so MXIndexedRecordIO can random-access/shuffle an existing pack).
+
+    python tools/rec2idx.py data.rec [data.idx]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", nargs="?", help="output .idx "
+                   "(default: alongside the .rec)")
+    args = p.parse_args()
+    idx_path = args.index or os.path.splitext(args.record)[0] + ".idx"
+
+    from mxnet_tpu import recordio as rio
+    reader = rio.MXRecordIO(args.record, "r")
+    n = 0
+    with open(idx_path, "w") as f:
+        while True:
+            pos = reader.tell()
+            rec = reader.read()
+            if rec is None:
+                break
+            # keys follow the packed header id when present, else ordinal
+            try:
+                header, _ = rio.unpack(rec)
+                key = int(header.id)
+            except Exception:
+                key = n
+            f.write("%d\t%d\n" % (key, pos))
+            n += 1
+    reader.close()
+    print("wrote %d entries to %s" % (n, idx_path))
+    return n
+
+
+if __name__ == "__main__":
+    main()
