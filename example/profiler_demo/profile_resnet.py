@@ -1,0 +1,65 @@
+"""Profiler walkthrough (reference: example/profiler/profiler_executor.py
+— configure, run a model under the profiler, dump Chrome-trace JSON).
+
+Produces <output>.json loadable in chrome://tracing / perfetto, plus the
+aggregate per-scope table.
+
+Usage: python profile_resnet.py [--steps 5] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--output", default="profile_resnet")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd, profiler
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net(mx.nd.zeros((1, 3, 32, 32)))
+    net.hybridize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+
+    profiler.set_config(filename=args.output, profile_all=True)
+    profiler.set_state("run")
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        x = mx.nd.array(rng.randn(args.batch_size, 3, 32,
+                                  32).astype("float32"))
+        y = mx.nd.array((np.arange(args.batch_size) % 10)
+                        .astype("float32"))
+        with profiler.Task("train_step"):
+            with autograd.record():
+                l = loss(net(x), y)
+            l.backward()
+            trainer.step(args.batch_size)
+            l.wait_to_read()
+    path = profiler.dump()
+    print("trace written:", path, "(%d bytes)" % os.path.getsize(path))
+    print(profiler.dumps())
+    assert os.path.getsize(path) > 0
+    return path
+
+
+if __name__ == "__main__":
+    main()

@@ -58,3 +58,9 @@ def test_transformer_ring_attention(extra):
 def test_custom_softmax_numpy_op():
     out = run_example("numpy_ops/custom_softmax.py", "--epochs", "2")
     assert "final train accuracy" in out
+
+
+def test_profiler_example(tmp_path):
+    out = run_example("profiler_demo/profile_resnet.py", "--steps", "2",
+                      "--output", str(tmp_path / "trace"))
+    assert "trace written" in out
