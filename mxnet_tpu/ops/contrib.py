@@ -477,3 +477,43 @@ def _ifft(data, *, compute_size=128):
     pairs = data.reshape(data.shape[:-1] + (D, 2))
     comp = pairs[..., 0] + 1j * pairs[..., 1]
     return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32)
+
+
+@register("_contrib_RingAttention")
+def _ring_attention_op(q, k, v, *, causal=True, axis_name="sp"):
+    """Sequence-parallel attention as a frontend op (no reference
+    analog — the 2018 framework has no SP; SURVEY.md §2.3). Inside a
+    `parallel.use_mesh(mesh)` scope with `axis_name` on the mesh, runs
+    the ppermute K/V ring (parallel/ring_attention.py); otherwise falls
+    back to plain single-device attention, so models written against
+    this op run unchanged from laptop to pod."""
+    from ..parallel.mesh import current_mesh
+    from ..parallel.ring_attention import ring_attention, local_attention
+    mesh = current_mesh()
+    if mesh is not None and axis_name in mesh.axis_names \
+            and mesh.shape[axis_name] > 1:
+        return ring_attention(q, k, v, mesh, axis_name, causal=causal)
+    return local_attention(q, k, v, causal=causal)
+
+
+@register("_contrib_MoEFFN", num_outputs=2)
+def _moe_ffn_op(data, gate_w, w1, b1, w2, b2, *, top_k=2,
+                capacity_factor=2.0, axis_name="ep"):
+    """Expert-parallel MoE FFN as a frontend op (no reference analog).
+    Outputs (out, aux_loss). Expert-parallel under `use_mesh` when
+    `axis_name` is on the active mesh; dense fallback otherwise."""
+    from ..parallel.mesh import current_mesh
+    from ..parallel.moe import moe_ffn, moe_ffn_dense
+    mesh = current_mesh()
+    if mesh is not None and axis_name in mesh.axis_names \
+            and mesh.shape[axis_name] > 1:
+        out, aux = moe_ffn(data, gate_w, w1, b1, w2, b2, mesh,
+                           axis_name, top_k=int(top_k),
+                           capacity_factor=float(capacity_factor))
+    else:
+        out, aux = moe_ffn_dense(
+            data, gate_w, w1, b1, w2, b2, top_k=int(top_k),
+            capacity=max(1, int(capacity_factor * top_k *
+                                data.shape[0] / gate_w.shape[1])))
+        out = out.astype(data.dtype)
+    return out, aux

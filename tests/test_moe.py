@@ -113,3 +113,43 @@ def test_expert_parallel_moe_ndarray_wrapper():
                            w2, b2, top_k=2)
     assert np.allclose(out.asnumpy(), np.asarray(ref),
                        rtol=1e-4, atol=1e-5)
+
+
+def test_contrib_ring_attention_op_mesh_vs_fallback():
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import use_mesh
+    rng = np.random.RandomState(5)
+    mk = lambda: mx.nd.array(rng.randn(1, 2, 16, 8).astype("float32"))
+    q, k, v = mk(), mk(), mk()
+    out_local = mx.nd.contrib.RingAttention(q, k, v, causal=True)
+    mesh = make_mesh({"sp": 8})
+    sh = shard_on(mesh, "sp", 2, 4)
+    put = lambda a: mx.nd.NDArray(
+        jax.device_put(jnp.asarray(a.asnumpy()), sh))
+    with use_mesh(mesh):
+        out_ring = mx.nd.contrib.RingAttention(put(q), put(k), put(v),
+                                               causal=True)
+    assert np.allclose(out_ring.asnumpy(), out_local.asnumpy(),
+                       atol=1e-4)
+
+
+def test_contrib_moe_ffn_op_mesh_vs_dense():
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import use_mesh
+    rng = np.random.RandomState(6)
+    gate_w, w1, b1, w2, b2 = _params(rng)
+    x = rng.randn(16, 8).astype("float32")
+    o_dense, _ = mx.nd.contrib.MoEFFN(
+        mx.nd.array(x), mx.nd.NDArray(gate_w), mx.nd.NDArray(w1),
+        mx.nd.NDArray(b1), mx.nd.NDArray(w2), mx.nd.NDArray(b2),
+        capacity_factor=8.0)
+    mesh = make_mesh({"ep": 8})
+    ep = shard_on(mesh, "ep", 0)
+    pe = lambda a: mx.nd.NDArray(jax.device_put(a, ep))
+    gwr = mx.nd.NDArray(jax.device_put(gate_w, replicated(mesh)))
+    with use_mesh(mesh):
+        o_ep, aux = mx.nd.contrib.MoEFFN(
+            pe(jnp.asarray(x)), gwr, pe(w1), pe(b1), pe(w2), pe(b2),
+            capacity_factor=8.0)
+    assert np.allclose(o_ep.asnumpy(), o_dense.asnumpy(), atol=1e-4)
+    assert np.isfinite(float(aux.asnumpy()))
