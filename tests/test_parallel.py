@@ -198,3 +198,39 @@ def test_step_many_matches_sequential_steps():
         many = st2.step_many(x, y, n_steps=5, unroll=unroll).asnumpy()
         np.testing.assert_allclose(seq, many, rtol=1e-5, atol=1e-6)
     assert st2._step_count == 5
+
+
+def test_weight_update_sharding_matches_replicated():
+    # ZeRO-1-style optimizer-state sharding (SURVEY 2.3 weight-update
+    # sharding): same numerics, momentum rows sharded over dp
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import gluon
+    from jax.sharding import PartitionSpec as P
+
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(32, activation="relu"), gnn.Dense(10))
+    net.initialize()
+    net(mx.nd.zeros((1, 16)))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype("float32")
+    y = (np.arange(16) % 10).astype("float32")
+    kw = dict(optimizer="adam", optimizer_params={"learning_rate": 0.01},
+              mesh=mesh)
+    a = ShardedTrainer(net, lambda o, l: loss(o, l), **kw)
+    b = ShardedTrainer(net, lambda o, l: loss(o, l),
+                       shard_optimizer_state=True, **kw)
+    la = [float(a.step(x, y).asscalar()) for _ in range(3)]
+    lb = [float(b.step(x, y).asscalar()) for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+    # momentum for a (32,16) dense weight is actually sharded over dp
+    m = b._opt_state["m"]["dense2_weight"] \
+        if "dense2_weight" in b._opt_state["m"] else None
+    if m is None:  # prefix numbering depends on prior tests
+        key = [k for k in b._opt_state["m"] if k.endswith("_weight")][0]
+        m = b._opt_state["m"][key]
+    assert m.sharding.spec == P("dp"), m.sharding
+    # params remain replicated for compute
+    k0 = [k for k in b._params if k.endswith("_weight")][0]
+    assert b._params[k0].sharding.spec == P()
