@@ -33,7 +33,31 @@ WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
 STEPS = int(os.environ.get("MXTPU_BENCH_STEPS", 50))
 
 
+def _probe_devices(timeout_s=180):
+    """Backend init hangs forever when the accelerator tunnel is down;
+    fail fast with a diagnosable message instead (the recorded metric
+    must be a real measurement or a clean error, never a hang)."""
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devs"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            result["err"] = e
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout=timeout_s)
+    if "devs" in result:
+        return result["devs"]
+    raise SystemExit(
+        "bench: device backend unreachable (%s after %ds)" % (
+            result.get("err", "init timed out"), timeout_s))
+
+
 def main():
+    _probe_devices()
     import jax
     jax.config.update("jax_default_matmul_precision", "bfloat16")
     import mxnet_tpu as mx
