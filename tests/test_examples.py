@@ -64,3 +64,8 @@ def test_profiler_example(tmp_path):
     out = run_example("profiler_demo/profile_resnet.py", "--steps", "2",
                       "--output", str(tmp_path / "trace"))
     assert "trace written" in out
+
+
+def test_quantization_example():
+    out = run_example("quantization/quantize_resnet.py")
+    assert "top-1 agreement" in out
