@@ -33,6 +33,16 @@ WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
 STEPS = int(os.environ.get("MXTPU_BENCH_STEPS", 50))
 
 
+def _apply_platform_override():
+    """MXTPU_BENCH_PLATFORM=cpu pins the backend via jax.config (for CI
+    smoke runs — the env-var spelling can still race plugin discovery
+    on machines with a configured accelerator tunnel)."""
+    plat = os.environ.get("MXTPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
 def _probe_devices(timeout_s=180):
     """Backend init hangs forever when the accelerator tunnel is down;
     fail fast with a diagnosable message instead (the recorded metric
@@ -57,6 +67,7 @@ def _probe_devices(timeout_s=180):
 
 
 def main():
+    _apply_platform_override()
     _probe_devices()
     import jax
     jax.config.update("jax_default_matmul_precision", "bfloat16")

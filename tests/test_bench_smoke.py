@@ -1,0 +1,25 @@
+"""bench.py must stay runnable: the driver executes it on real hardware
+at round end, so a CPU smoke run with tiny shapes gates bitrot."""
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_cpu():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(MXTPU_BENCH_PLATFORM="cpu", MXTPU_BENCH_BATCH="8",
+               MXTPU_BENCH_IMG="32", MXTPU_BENCH_STEPS="2",
+               MXTPU_BENCH_WARMUP="1", MXTPU_BENCH_SCORE_BATCH="4",
+               MXTPU_BENCH_UNROLL="1")
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
+                       capture_output=True, text=True, timeout=900,
+                       env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    out = json.loads(line)
+    assert out["metric"].startswith("resnet50_v1_train_throughput")
+    assert out["value"] > 0 and out["unit"] == "img/s"
+    assert "score_b4_img_s" in out["extra"]
