@@ -21,7 +21,8 @@ from ....ndarray import NDArray
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomColorJitter", "RandomLighting"]
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
 
 
 def _to_np(x):
@@ -233,8 +234,32 @@ class RandomSaturation(_HostTransform):
             .astype(img.dtype)
 
 
+class RandomHue(_HostTransform):
+    """Random hue jitter via YIQ-plane rotation
+    (reference: transforms.py:407 — the image.HueJitterAug math)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def _apply(self, img):
+        alpha = random.uniform(-self._hue, self._hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
+        m = t_rgb @ rot @ t_yiq
+        x = img.astype(np.float32) @ m.T
+        return np.clip(x, 0, 255 if img.dtype == np.uint8 else np.inf) \
+            .astype(img.dtype)
+
+
 class RandomColorJitter(_HostTransform):
-    """Random brightness/contrast/saturation jitter
+    """Random brightness/contrast/saturation/hue jitter
     (reference: transforms.py:391)."""
 
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
@@ -246,6 +271,8 @@ class RandomColorJitter(_HostTransform):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def _apply(self, img):
         ts = list(self._ts)
