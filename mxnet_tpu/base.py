@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 import numpy as np
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 
 class MXNetError(RuntimeError):
