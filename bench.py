@@ -29,7 +29,6 @@ SCORE_BASELINE_FP16 = 2085.51
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 128))
 SCORE_BATCH = int(os.environ.get("MXTPU_BENCH_SCORE_BATCH", 32))
 IMG = int(os.environ.get("MXTPU_BENCH_IMG", 224))
-WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
 STEPS = int(os.environ.get("MXTPU_BENCH_STEPS", 50))
 
 
@@ -44,26 +43,14 @@ def _apply_platform_override():
 
 
 def _probe_devices(timeout_s=180):
-    """Backend init hangs forever when the accelerator tunnel is down;
-    fail fast with a diagnosable message instead (the recorded metric
-    must be a real measurement or a clean error, never a hang)."""
-    import threading
-    result = {}
-
-    def probe():
-        try:
-            import jax
-            result["devs"] = jax.devices()
-        except Exception as e:  # noqa: BLE001
-            result["err"] = e
-    th = threading.Thread(target=probe, daemon=True)
-    th.start()
-    th.join(timeout=timeout_s)
-    if "devs" in result:
-        return result["devs"]
-    raise SystemExit(
-        "bench: device backend unreachable (%s after %ds)" % (
-            result.get("err", "init timed out"), timeout_s))
+    """Fail fast with a diagnosable message when the backend is
+    unreachable (the recorded metric must be a real measurement or a
+    clean error, never a hang)."""
+    from mxnet_tpu.base import probe_devices
+    devs, err = probe_devices(timeout_s)
+    if devs is None:
+        raise SystemExit("bench: device backend unreachable (%s)" % err)
+    return devs
 
 
 def main():

@@ -111,13 +111,27 @@ def imdecode_jpeg(data, short_side=0):
     return arr.reshape(h.value, w.value, c.value)
 
 
+def _lib_path():
+    """The env override (MXTPU_LIBRARY_PATH, reference
+    MXNET_LIBRARY_PATH) wins over the in-tree build — matching
+    libinfo.find_lib_path, which (like the reference) skips candidates
+    that don't exist rather than letting a stale override silently
+    disable the native runtime."""
+    for cand in (os.environ.get("MXTPU_LIBRARY_PATH"),
+                 os.environ.get("MXNET_LIBRARY_PATH")):
+        if cand and os.path.exists(cand):
+            return cand
+    return _LIB_PATH
+
+
 def _try_load():
     global LIB
     if LIB is not None:
         return LIB
-    if os.path.exists(_LIB_PATH):
+    path = _lib_path()
+    if os.path.exists(path):
         try:
-            LIB = _bind(ctypes.CDLL(_LIB_PATH))
+            LIB = _bind(ctypes.CDLL(path))
         except OSError:
             LIB = None
     return LIB

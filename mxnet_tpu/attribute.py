@@ -42,9 +42,11 @@ class AttrScope:
         return merged
 
     def get(self, attr=None):
-        """Merge scope attrs into `attr` (reference API; explicit attrs
-        win over scoped defaults)."""
+        """Merge this scope's attrs (reference API: an un-entered
+        AttrScope(x='y').get() returns {'x': 'y'}) plus any active
+        scope stack into `attr`; explicit attrs win."""
         merged = AttrScope.current_attrs()
+        merged.update(self._attr)
         merged.update(attr or {})
         return merged
 

@@ -52,6 +52,32 @@ def dtype_name(dtype) -> str:
     return dtype.name
 
 
+def probe_devices(timeout_s=60):
+    """Probe jax.devices() with a deadline from a daemon thread.
+
+    Backend init hangs indefinitely when an accelerator tunnel is dead;
+    callers that must not hang (bench, diagnose) use this. Returns
+    (devices, None) on success, (None, error_message) on timeout or
+    failure."""
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devs"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — reported to caller
+            result["err"] = str(e)
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout=timeout_s)
+    if "devs" in result:
+        return result["devs"], None
+    return None, result.get("err",
+                            "init timed out after %ds" % timeout_s)
+
+
 def getenv(name, default):
     """Env-var config plane (reference: dmlc::GetEnv, docs/faq/env_var.md).
 

@@ -28,10 +28,15 @@ __all__ = ["TrainerCheckpoint"]
 
 
 def _state_of(trainer):
-    return {"params": dict(trainer._params),
-            "aux": dict(trainer._aux),
-            "opt_state": trainer._opt_state,
-            "step": trainer._step_count}
+    state = {"params": dict(trainer._params),
+             "aux": dict(trainer._aux),
+             "opt_state": trainer._opt_state,
+             "step": trainer._step_count}
+    # gradient-compression error-feedback residuals are training state:
+    # dropping them on resume silently diverges the compressed exchange
+    if getattr(trainer, "_gc_residuals", None) is not None:
+        state["gc_residuals"] = dict(trainer._gc_residuals)
+    return state
 
 
 class TrainerCheckpoint:
@@ -73,15 +78,41 @@ class TrainerCheckpoint:
         shardings = jax.tree.map(
             lambda x: x.sharding if hasattr(x, "sharding") else None,
             target)
-        restored = self._mngr.restore(
-            int(step),
-            args=self._ocp.args.StandardRestore(target))
+        try:
+            restored = self._mngr.restore(
+                int(step),
+                args=self._ocp.args.StandardRestore(target))
+        except Exception as err:
+            # Recoverable ONLY for structure drift on the optional
+            # gc_residuals key (old checkpoints lack it; compressed-
+            # trainer checkpoints carry it into a plain trainer). Any
+            # other mismatch — wrong shapes, different keys, corrupt
+            # data — re-raises the original validation error.
+            import numpy as _np
+            raw = self._mngr.restore(int(step))
+            if (set(raw) ^ set(target)) - {"gc_residuals"}:
+                raise
+            restored = {}
+            for k, tgt in target.items():
+                if k not in raw:
+                    restored[k] = tgt  # absent on disk: keep current
+                    continue
+                if (jax.tree.structure(raw[k])
+                        != jax.tree.structure(tgt)):
+                    raise err
+                for a, b in zip(jax.tree.leaves(raw[k]),
+                                jax.tree.leaves(tgt)):
+                    if _np.shape(a) != _np.shape(b):
+                        raise err
+                restored[k] = raw[k]
         restored = jax.tree.map(
             lambda v, s: jax.device_put(v, s) if s is not None else v,
             restored, shardings)
         trainer._params = dict(restored["params"])
         trainer._aux = dict(restored["aux"])
         trainer._opt_state = restored["opt_state"]
+        if "gc_residuals" in restored:
+            trainer._gc_residuals = dict(restored["gc_residuals"])
         trainer._step_count = int(restored["step"])
         return trainer._step_count
 

@@ -136,6 +136,12 @@ class ShardedTrainer:
                                  "data-parallel mesh (no param_rules)")
             self._grad_compression = {"threshold":
                                       float(gc.get("threshold", 0.5))}
+            if shard_optimizer_state:
+                raise MXNetError(
+                    "shard_optimizer_state is not supported with "
+                    "gradient_compression (the compressed step keeps "
+                    "replicated optimizer state around its per-device "
+                    "residual exchange)")
         if mesh is None:
             mesh = current_mesh()  # use_mesh() scope, if any
         self._mesh = mesh if mesh is not None else make_mesh()

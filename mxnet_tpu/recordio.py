@@ -266,14 +266,52 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         return pack(header, b"NPY0" + buf.getvalue())
 
 
+def _jpeg_components(s):
+    """Component count (1=grayscale, 3=YCbCr/RGB) from the JPEG SOF
+    marker; 0 if no SOF is found before the scan data."""
+    i = 2
+    n = len(s)
+    while i + 9 < n:
+        if s[i] != 0xFF:
+            i += 1
+            continue
+        marker = s[i + 1]
+        if marker in (0xC0, 0xC1, 0xC2, 0xC3, 0xC5, 0xC6, 0xC7,
+                      0xC9, 0xCA, 0xCB, 0xCD, 0xCE, 0xCF):
+            return s[i + 9]
+        if marker == 0xDA:  # start of scan — SOF must precede it
+            return 0
+        if marker == 0xFF:  # fill byte: stay on the 0xFF run
+            i += 1
+            continue
+        if 0xD0 <= marker <= 0xD9 or marker == 0x01:
+            i += 2
+            continue
+        seg_len = (s[i + 2] << 8) | s[i + 3]
+        i += 2 + seg_len
+    return 0
+
+
 def _imdecode(s, iscolor=-1):
     if s[:4] == b"NPY0":
         return np.load(_pyio.BytesIO(s[4:]))
-    if iscolor != 0 and s[:2] == b"\xff\xd8":  # JPEG: native fast path
+    # native fast path: the C decoder always emits (H, W, 3), so for
+    # iscolor=-1 ("as stored") a grayscale source must collapse back to
+    # 2-D (all three channels are identical by construction) to keep the
+    # output shape independent of whether the lib is built
+    if s[:2] == b"\xff\xd8":
         from ._native import imdecode_jpeg
-        img = imdecode_jpeg(bytes(s))
-        if img is not None:
-            return img
+        ncomp = _jpeg_components(s)
+        if iscolor == 1 or ncomp == 1 or (iscolor == -1 and ncomp == 3):
+            img = imdecode_jpeg(bytes(s))
+            if img is not None:
+                if iscolor == 1:
+                    return img
+                if ncomp == 1:           # grayscale source
+                    return img[:, :, 0]  # -1: as stored; 0: already gray
+                return img
+        # remaining case (iscolor=0 on a color JPEG) needs a luma
+        # conversion matching PIL's — fall through
     try:
         from PIL import Image
         img = Image.open(_pyio.BytesIO(s))

@@ -5,9 +5,15 @@ exact equality): every worker pushes a rank-dependent value and asserts
 the pulled result equals the exact sum, across dense fp32, fp16, big,
 and row_sparse-gathered keys, plus the updater path.
 """
+import os
 import sys
 
 import numpy as np
+
+# runnable as a plain user command (`tools/launch.py -n N python
+# tests/dist_kvstore_worker.py`) without PYTHONPATH games
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main():

@@ -11,8 +11,7 @@ def test_bench_smoke_cpu():
     env = dict(os.environ)
     env.update(MXTPU_BENCH_PLATFORM="cpu", MXTPU_BENCH_BATCH="8",
                MXTPU_BENCH_IMG="32", MXTPU_BENCH_STEPS="2",
-               MXTPU_BENCH_WARMUP="1", MXTPU_BENCH_SCORE_BATCH="4",
-               MXTPU_BENCH_UNROLL="1")
+               MXTPU_BENCH_SCORE_BATCH="4", MXTPU_BENCH_UNROLL="1")
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
                        capture_output=True, text=True, timeout=900,

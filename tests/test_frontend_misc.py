@@ -85,3 +85,11 @@ def test_torch_roundtrip():
     np.testing.assert_allclose(back.asnumpy(), x.asnumpy() * 2 + 1)
     with pytest.raises(mx.MXNetError):
         mx.torch.from_torch(np.zeros(3))
+
+
+def test_attrscope_get_unentered_returns_own_attrs():
+    # reference API: AttrScope(x='y').get() == {'x': 'y'} without
+    # entering the scope; explicit attr arg wins
+    s = mx.AttrScope(x="y", z="1")
+    assert s.get() == {"x": "y", "z": "1"}
+    assert s.get({"z": "9"}) == {"x": "y", "z": "9"}

@@ -287,3 +287,35 @@ def test_native_jpeg_decode_matches_pil_and_scales():
     buf2 = pyio.BytesIO()
     Image.fromarray(im[:, :, 0]).save(buf2, format="JPEG")
     assert imdecode_jpeg(buf2.getvalue()).shape == (96, 128, 3)
+
+
+def test_unpack_img_grayscale_shape_independent_of_native_lib():
+    # iscolor=-1 must keep a grayscale JPEG 2-D even when the native
+    # RGB-only decoder is built (it is only used for iscolor=1)
+    import io as pyio
+    pytest.importorskip("PIL")
+    from PIL import Image
+    import mxnet_tpu.recordio as rio
+    im = (np.random.RandomState(0).rand(16, 12) * 255).astype(np.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(im).save(buf, format="JPEG")
+    rec = rio.pack(rio.IRHeader(0, 1.0, 0, 0), buf.getvalue())
+    _, img_as_stored = rio.unpack_img(rec, iscolor=-1)
+    assert img_as_stored.ndim == 2
+    _, img_color = rio.unpack_img(rec, iscolor=1)
+    assert img_color.shape == (16, 12, 3)
+
+
+def test_library_path_override_honored(tmp_path, monkeypatch):
+    # MXTPU_LIBRARY_PATH must be what the loader actually dlopens
+    from mxnet_tpu import _native
+    real = tmp_path / "fake.so"
+    real.write_bytes(b"")
+    monkeypatch.setenv("MXTPU_LIBRARY_PATH", str(real))
+    assert _native._lib_path() == str(real)
+    # a stale override must not silently disable the in-tree lib
+    monkeypatch.setenv("MXTPU_LIBRARY_PATH", str(tmp_path / "nope.so"))
+    assert _native._lib_path() == _native._LIB_PATH
+    monkeypatch.delenv("MXTPU_LIBRARY_PATH")
+    monkeypatch.delenv("MXNET_LIBRARY_PATH", raising=False)
+    assert _native._lib_path() == _native._LIB_PATH

@@ -88,3 +88,70 @@ def test_async_save_and_max_to_keep(tmp_path):
         assert ck.all_steps() == [3, 4]  # pruned to max_to_keep
         b = _trainer(net, make_mesh({"dp": 8}))
         assert ck.restore_latest(b) == a._step_count
+
+
+def test_compressed_trainer_checkpoints_residuals(tmp_path):
+    # error-feedback residuals are training state: resume must carry
+    # them, or the compressed exchange diverges from an uninterrupted run
+    rng = np.random.RandomState(3)
+    net = _net()
+    x, y = _batch(rng)
+    gc = {"type": "2bit", "threshold": 0.05}
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mk = lambda: ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                                {"learning_rate": 0.05},
+                                mesh=make_mesh({"dp": 8}),
+                                gradient_compression=gc)
+    a = mk()
+    for _ in range(3):
+        a.step(x, y)
+    with TrainerCheckpoint(tmp_path / "ckgc") as ck:
+        ck.save(3, a, wait=True)
+        after = [float(a.step(x, y).asscalar()) for _ in range(2)]
+        b = mk()
+        assert ck.restore_latest(b) == 3
+        resumed = [float(b.step(x, y).asscalar()) for _ in range(2)]
+    np.testing.assert_allclose(after, resumed, rtol=1e-5, atol=1e-6)
+
+
+def test_shard_opt_state_rejected_with_compression():
+    import pytest
+    net = _net()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    with pytest.raises(mx.MXNetError):
+        ShardedTrainer(net, lambda o, l: loss(o, l), "sgd", {},
+                       mesh=make_mesh({"dp": 8}),
+                       gradient_compression={"type": "2bit"},
+                       shard_optimizer_state=True)
+
+
+def test_restore_across_compression_config_changes(tmp_path):
+    # checkpoints from a plain trainer restore into a compressed one
+    # (residuals keep their fresh zeros) and vice versa (extra key on
+    # disk ignored) — structure drift must not break resume
+    rng = np.random.RandomState(4)
+    net = _net()
+    x, y = _batch(rng)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mk = lambda **kw: ShardedTrainer(net, lambda o, l: loss(o, l),
+                                     "sgd", {"learning_rate": 0.05},
+                                     mesh=make_mesh({"dp": 8}), **kw)
+    gc = {"gradient_compression": {"type": "2bit", "threshold": 0.05}}
+    plain = mk()
+    plain.step(x, y)
+    with TrainerCheckpoint(tmp_path / "p2c") as ck:
+        ck.save(1, plain, wait=True)
+        comp = mk(**gc)
+        assert ck.restore_latest(comp) == 1
+        assert float(comp.step(x, y).asscalar()) > 0
+    comp2 = mk(**gc)
+    for _ in range(2):
+        comp2.step(x, y)
+    with TrainerCheckpoint(tmp_path / "c2p") as ck:
+        ck.save(2, comp2, wait=True)
+        plain2 = mk()
+        assert ck.restore_latest(plain2) == 2
+        for k in comp2._params:
+            np.testing.assert_allclose(np.asarray(plain2._params[k]),
+                                       np.asarray(comp2._params[k]),
+                                       rtol=1e-6, atol=1e-7)

@@ -36,27 +36,13 @@ def main():
     print("jax          :", jax.__version__)
     print("jaxlib       :", jaxlib.__version__)
     t0 = time.time()
-    # backend init can hang forever on a dead accelerator tunnel —
-    # probe from a daemon thread with a deadline
-    import threading
-    result = {}
-
-    def probe():
-        try:
-            result["devs"] = [str(d) for d in jax.devices()]
-        except Exception as e:  # noqa: BLE001 — report, don't crash
-            result["err"] = str(e)
-
-    th = threading.Thread(target=probe, daemon=True)
-    th.start()
-    th.join(timeout=30)
-    if th.is_alive():
-        print("devices      : TIMED OUT after 30s (backend unreachable?)")
-    elif "devs" in result:
+    from mxnet_tpu.base import probe_devices
+    devs, err = probe_devices(timeout_s=30)
+    if devs is not None:
         print("devices      : %s (probe %.2fs)"
-              % (result["devs"], time.time() - t0))
+              % ([str(d) for d in devs], time.time() - t0))
     else:
-        print("devices      : UNAVAILABLE (%s)" % result.get("err"))
+        print("devices      : UNAVAILABLE (%s)" % err)
 
     print("----------Deps----------")
     for name in ("numpy", "flax", "optax", "orbax.checkpoint", "PIL",
