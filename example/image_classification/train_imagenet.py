@@ -15,6 +15,11 @@ Usage:
   python train_imagenet.py --data-train train.rec        # real records
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
 import time
 
 import numpy as np
@@ -38,11 +43,17 @@ def parse_args():
     p.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"])
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--cpu", action="store_true")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import jax
     jax.config.update("jax_default_matmul_precision", "bfloat16")
     import mxnet_tpu as mx

@@ -11,6 +11,10 @@ a real one (e.g. the reference's kdda/avazu downloads).
 """
 import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
 import tempfile
 
 import numpy as np
@@ -35,7 +39,13 @@ def main():
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd

@@ -11,20 +11,33 @@ Synthetic CTR-style task: each sample has `NNZ` categorical ids out of
 (hidden) id weights is positive.
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
 
 import numpy as np
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--vocab", type=int, default=10000)
+    # defaults sized so each row gets enough visits to learn (~10
+    # SGD touches/row): vocab 2k x 200 batches reaches ~0.8 accuracy
+    p.add_argument("--vocab", type=int, default=2000)
     p.add_argument("--dim", type=int, default=16)
     p.add_argument("--nnz", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=64)
-    p.add_argument("--num-batches", type=int, default=60)
+    p.add_argument("--num-batches", type=int, default=200)
     p.add_argument("--lr", type=float, default=0.5)
     p.add_argument("--kv-store", default="local")
+    p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd, autograd
