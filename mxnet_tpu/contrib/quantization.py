@@ -282,6 +282,14 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             "'none')" % calib_mode)
 
     if quantize_mode == "full" and quantized_dtype == "int8":
+        if not ranges:
+            # _rewrite_int8 lowers only nodes with calibrated ranges —
+            # no ranges would return the fp32 graph unchanged, silently
+            raise MXNetError(
+                "quantize_mode='full' requires calibrated activation "
+                "ranges: pass calib_data with calib_mode 'naive' or "
+                "'entropy' (or use quantize_mode='qdq' for "
+                "calibration-free fake-quant)")
         qsym = _rewrite_int8(sym, ranges, excluded_sym_names,
                              quantize_ops)
     else:

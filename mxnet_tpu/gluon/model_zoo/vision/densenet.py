@@ -10,9 +10,7 @@ from ...block import HybridBlock
 from ... import nn
 
 
-def _cax(layout):
-    from ....ops.nn import channel_axis
-    return channel_axis(layout, len(layout))
+from ....ops.nn import bn_axis as _cax  # shared layout helper
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]

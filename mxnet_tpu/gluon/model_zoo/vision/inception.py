@@ -13,9 +13,7 @@ from ... import nn
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _bn_axis(layout):
-    from ....ops.nn import channel_axis
-    return channel_axis(layout, len(layout))
+from ....ops.nn import bn_axis as _bn_axis  # shared layout helper
 
 
 def _make_basic_conv(layout="NCHW", **kwargs):
@@ -43,21 +41,7 @@ def _make_branch(use_pool, layout, *conv_settings):
     return out
 
 
-class _Concurrent(HybridBlock):
-    """Runs children on the same input and concats outputs on channels
-    (the reference uses gluon.contrib.nn.HybridConcurrent)."""
-
-    def __init__(self, axis=1, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._axis = axis
-
-    def add(self, *blocks):
-        for b in blocks:
-            self.register_child(b)
-
-    def hybrid_forward(self, F, x):
-        outs = [child(x) for child in self._children.values()]
-        return F.concat(*outs, dim=self._axis)
+from ...contrib.nn import HybridConcurrent as _Concurrent
 
 
 def _make_A(pool_features, prefix, layout):

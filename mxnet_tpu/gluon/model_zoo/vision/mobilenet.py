@@ -25,9 +25,7 @@ class RELU6(HybridBlock):
         return F.clip(x, a_min=0.0, a_max=6.0, name="relu6")
 
 
-def _bn_axis(layout):
-    from ....ops.nn import channel_axis
-    return channel_axis(layout, len(layout))
+from ....ops.nn import bn_axis as _bn_axis  # shared layout helper
 
 
 def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,

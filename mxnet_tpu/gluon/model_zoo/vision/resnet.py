@@ -24,9 +24,7 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _bn_axis(layout):
-    from ....ops.nn import channel_axis
-    return channel_axis(layout, len(layout))
+from ....ops.nn import bn_axis as _bn_axis  # shared layout helper
 
 
 def _conv3x3(channels, stride, in_channels, layout="NCHW"):

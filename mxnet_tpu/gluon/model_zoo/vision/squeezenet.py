@@ -11,9 +11,7 @@ from ... import nn
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
 
-def _cax(layout):
-    from ....ops.nn import channel_axis
-    return channel_axis(layout, len(layout))
+from ....ops.nn import bn_axis as _cax  # shared layout helper
 
 
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels,

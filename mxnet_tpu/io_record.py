@@ -58,6 +58,10 @@ class ImageRecordIter(DataIter):
         self._data_shape = tuple(int(s) for s in data_shape)
         self._label_width = int(label_width)
         self._shuffle = shuffle
+        # records are shuffled over a buffer spanning many batches, not
+        # within one chunk (which would keep batch membership in file
+        # order — reference: iter_image_recordio_2's shuffle_chunk_size)
+        self._shuffle_chunk = int(shuffle_chunk_size or 16 * batch_size)
         self._rng = np.random.RandomState(seed)
         self._threads = max(1, int(preprocess_threads))
         self._depth = max(1, int(prefetch_buffer))
@@ -132,13 +136,15 @@ class ImageRecordIter(DataIter):
         # waiting for this thread must not let it touch the NEW epoch's
         # queue through self
         carry = []
+        buf = []  # shuffle buffer spanning ~shuffle_chunk records
         try:
-            for records in loader:
-                if stop.is_set():
-                    return
-                records = list(records)
-                if self._shuffle:
-                    self._rng.shuffle(records)
+            def drain(buf):
+                self._rng.shuffle(buf)
+                out, rest = buf, []
+                return out, rest
+
+            def emit(records):
+                nonlocal carry
                 samples = carry + list(self._pool.map(self._decode_one,
                                                       records))
                 while len(samples) >= self.batch_size:
@@ -146,6 +152,20 @@ class ImageRecordIter(DataIter):
                                       samples[self.batch_size:])
                     self._put(q, stop, self._collate(chunk, pad=0))
                 carry = samples
+
+            for records in loader:
+                if stop.is_set():
+                    return
+                if self._shuffle:
+                    buf.extend(records)
+                    if len(buf) >= self._shuffle_chunk:
+                        chunk, buf = drain(buf)
+                        emit(chunk)
+                else:
+                    emit(list(records))
+            if buf and not stop.is_set():
+                chunk, _ = drain(buf)
+                emit(chunk)
             if carry and self._round_batch:
                 pad = self.batch_size - len(carry)
                 carry = carry + [carry[-1]] * pad

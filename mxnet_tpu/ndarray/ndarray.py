@@ -33,8 +33,12 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
 
 
 # every live NDArray, so waitall() can fence on all in-flight results
-# (reference: Engine::WaitForAll orders against every dispatched op)
+# (reference: Engine::WaitForAll orders against every dispatched op).
+# WeakSet is not thread-safe and input-pipeline worker threads create
+# NDArrays concurrently with a main-thread waitall(): all access goes
+# through _live_lock.
 _live_arrays = weakref.WeakSet()
+_live_lock = __import__("threading").Lock()
 
 
 class NDArray:
@@ -55,7 +59,8 @@ class NDArray:
         self._tape_node = None
         self._tape_index = 0
         self._stype = _stype
-        _live_arrays.add(self)
+        with _live_lock:
+            _live_arrays.add(self)
 
     # ------------------------------------------------------------------
     # properties
@@ -577,7 +582,9 @@ def waitall():
     MXNDArrayWaitAll -> Engine::WaitForAll). A TRUE fence: blocks on the
     current buffer of every live NDArray (JAX async dispatch), flushes
     effectful computations, and drains the native host engine."""
-    for arr in list(_live_arrays):
+    with _live_lock:
+        snapshot = list(_live_arrays)
+    for arr in snapshot:
         data = arr._data
         if isinstance(data, jax.Array):
             if getattr(data, "is_deleted", lambda: False)():

@@ -275,6 +275,12 @@ def channel_axis(layout, ndim):
     return (ndim - 1) if is_channels_last(layout) else 1
 
 
+def bn_axis(layout):
+    """Channel axis for a layout string like "NCHW"/"NHWC" (the axis=
+    argument BatchNorm/concat take in layout-aware model-zoo code)."""
+    return channel_axis(layout, len(layout))
+
+
 def _conv_dim_numbers(ndim, layout):
     if layout is None:
         layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]

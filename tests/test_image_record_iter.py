@@ -180,3 +180,18 @@ def test_imread_copymakeborder(tmp_path):
     pn = p.asnumpy()
     assert (pn[0] == 7).all() and (pn[-1] == 7).all()
     assert (pn[1:-2, 3:-4] == 0).all()
+
+
+def test_shuffle_mixes_across_batches(rec_path):
+    # shuffle must permute MEMBERSHIP over a multi-batch buffer, not
+    # just order within one batch-size chunk (reference:
+    # iter_image_recordio_2 shuffle_chunk_size)
+    import mxnet_tpu as mx
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, batch_size=4,
+                               data_shape=(3, 8, 8), shuffle=True,
+                               seed=3, preprocess_threads=2)
+    first = next(iter(it))
+    labels = sorted(first.label[0].asnumpy().ravel().tolist())
+    # file order would give exactly labels [0,1,2,3] in the first batch
+    assert labels != [0.0, 1.0, 2.0, 3.0], \
+        "first batch membership identical to file order"
