@@ -94,3 +94,51 @@ def test_softmax_gradient(op):
     data = _rand((3, 5), -2, 2, seed=4)
     check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
                            rtol=5e-2, atol=1e-3)
+
+
+KINK_OPS = [
+    # ops with kinks/selections: domains chosen so no tie/kink is near
+    ("abs", (0.5, 2.0)),
+    ("negative", (-2, 2)),
+    ("relu", (0.3, 2.0)),
+]
+
+
+@pytest.mark.parametrize("op,domain", KINK_OPS,
+                         ids=[o for o, _ in KINK_OPS])
+def test_kink_op_gradient_away_from_kink(op, domain):
+    x = sym.var("x")
+    out = getattr(sym, op)(x)
+    data = _rand((3, 4), *domain, seed=11)
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_broadcast_maximum_minimum_gradient():
+    a, b = sym.var("a"), sym.var("b")
+    # disjoint domains: a in (2,3), b in (0,1) — argmax never flips
+    loc = {"a": _rand((3, 4), 2.0, 3.0, seed=7),
+           "b": _rand((1, 4), 0.0, 1.0, seed=8)}
+    for op in ("broadcast_maximum", "broadcast_minimum"):
+        out = getattr(sym, op)(a, b)
+        check_numeric_gradient(out, loc, numeric_eps=1e-3,
+                               rtol=5e-2, atol=1e-3)
+
+
+def test_clip_gradient_inside_range():
+    x = sym.var("x")
+    out = sym.clip(x, a_min=-10.0, a_max=10.0)
+    data = _rand((3, 4), -2, 2, seed=9)
+    check_numeric_gradient(out, {"x": data}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_where_gradient():
+    c, a, b = sym.var("c"), sym.var("a"), sym.var("b")
+    out = sym.where(c, a, b)
+    rng = np.random.RandomState(10)
+    loc = {"c": mx.nd.array((rng.rand(3, 4) > 0.5).astype("float32")),
+           "a": _rand((3, 4), -2, 2, seed=12),
+           "b": _rand((3, 4), -2, 2, seed=13)}
+    check_numeric_gradient(out, loc, grad_nodes=["a", "b"],
+                           numeric_eps=1e-3, rtol=5e-2, atol=1e-3)
