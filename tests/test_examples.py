@@ -69,3 +69,13 @@ def test_profiler_example(tmp_path):
 def test_quantization_example():
     out = run_example("quantization/quantize_resnet.py")
     assert "top-1 agreement" in out
+
+
+def test_sharded_resnet_example():
+    out = run_example("parallel/sharded_resnet.py", "--steps", "2")
+    assert "params synced" in out
+
+
+def test_gluon_cifar10_example():
+    out = run_example("gluon/train_cifar10.py", "--epochs", "1")
+    assert "epoch 0" in out
