@@ -513,10 +513,11 @@ def _moe_ffn_op(data, gate_w, w1, b1, w2, b2, *, top_k=2,
     else:
         # ceiling, matching moe_ffn's per-device capacity rounding so
         # token-drop behavior agrees between fallback and mesh paths
-        cap = -(-int(capacity_factor * top_k * data.shape[0])
-                // gate_w.shape[1])
+        import math
+        cap = max(1, math.ceil(capacity_factor * top_k
+                               * data.shape[0] / gate_w.shape[1]))
         out, aux = moe_ffn_dense(
             data, gate_w, w1, b1, w2, b2, top_k=int(top_k),
-            capacity=max(1, cap))
+            capacity=cap)
         out = out.astype(data.dtype)
     return out, aux

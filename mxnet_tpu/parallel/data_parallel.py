@@ -54,8 +54,11 @@ def sgd_update(params, grads, state, lr=0.01, momentum=0.0, wd=0.0):
     new_p, new_s = {}, {}
     for k, p in params.items():
         g = grads[k] + wd * p
-        m = momentum * state[k] + g
-        new_s[k] = m
+        if momentum:
+            m = momentum * state[k] + g
+            new_s[k] = m
+        else:  # plain SGD carries no state (reference optimizer.py SGD)
+            m = g
         new_p[k] = p - lr * m
     return new_p, new_s
 
@@ -193,7 +196,10 @@ class ShardedTrainer:
                                             sorted(_OPTIMIZERS)))
         opt_init, opt_update, defaults = _OPTIMIZERS[optimizer]
         self._opt_hp = {**defaults, **opt_params}
-        self._opt_state = opt_init(self._params)
+        if optimizer == "sgd" and not self._opt_hp.get("momentum"):
+            self._opt_state = {}  # plain SGD: no state to allocate
+        else:
+            self._opt_state = opt_init(self._params)
         self._opt_update = opt_update
         if self._shard_opt:
             # place optimizer state on its dp-sharded layout up front so
@@ -234,9 +240,15 @@ class ShardedTrainer:
             jnp.array(value, copy=True),
             NamedSharding(self._mesh, self._spec_for(name)))
 
-    def _batch_sharding(self):
-        spec = [None] * (self._batch_axis + 1)
-        spec[self._batch_axis] = self._dp_axis_name()
+    def _batch_sharding(self, ndim=None):
+        """Sharding splitting the batch axis over dp. For arrays of
+        lower rank than batch_axis+1 (e.g. (B,) labels alongside
+        batch_axis=1 TNC data) the batch axis clamps to dim 0."""
+        ax = self._batch_axis
+        if ndim is not None and ax >= ndim:
+            ax = 0
+        spec = [None] * (ax + 1)
+        spec[ax] = self._dp_axis_name()
         return NamedSharding(self._mesh, PartitionSpec(*spec))
 
     # -- compiled step --------------------------------------------------
@@ -301,7 +313,8 @@ class ShardedTrainer:
         else:
             opt_sh = _match_param_shardings(self._opt_state, param_sh,
                                             rep)
-        in_sh = {n: self._batch_sharding()
+        ndims = getattr(self, "_input_ndims", {})
+        in_sh = {n: self._batch_sharding(ndims.get(n))
                  for n in self._data_names + self._label_names}
         return param_sh, aux_sh, opt_sh, in_sh, rep
 
@@ -357,16 +370,19 @@ class ShardedTrainer:
         if self._grad_compression is not None:
             raise MXNetError("step_many: not supported with gradient "
                              "compression; call step() per batch")
-        if getattr(self, "_step_many_fn", None) is None:
-            self._build_step_many()
         names = self._data_names + self._label_names
         if len(batch_and_labels) != len(names):
             raise MXNetError("step_many expects %s" % (names,))
-        sh = self._batch_sharding()
         inputs = {}
+        ndims = {}
         for n, x in zip(names, batch_and_labels):
             arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
-            inputs[n] = jax.device_put(arr, sh)
+            ndims[n] = arr.ndim
+            inputs[n] = jax.device_put(arr,
+                                       self._batch_sharding(arr.ndim))
+        if getattr(self, "_step_many_fn", None) is None:
+            self._input_ndims = ndims
+            self._build_step_many()
         key = _random.next_key() if self._needs_rng else None
         self._params, self._aux, self._opt_state, losses = \
             self._step_many_fn(self._params, self._aux, self._opt_state,
@@ -379,13 +395,7 @@ class ShardedTrainer:
         quantize -> all_gather(packed) -> dequantize+sum gradient
         exchange. The optimizer update runs on the (replicated)
         reconstructed gradient outside the shard_map."""
-        import functools
-        try:
-            from jax import shard_map as _sm
-            shard_map = functools.partial(_sm, check_vma=False)
-        except ImportError:  # older jax spelling
-            from jax.experimental.shard_map import shard_map as _sm
-            shard_map = functools.partial(_sm, check_rep=False)
+        from .mesh import shard_map_compat
         from ..gradient_compression import quantize_2bit, dequantize_2bit
 
         fn = self._fn
@@ -400,7 +410,11 @@ class ShardedTrainer:
         batch_axis = self._batch_axis
 
         def shard_grads(params, aux, inputs, residuals, key):
-            # runs per-device: local batch shard, replicated params
+            # runs per-device: local batch shard, replicated params.
+            # distinct randomness per shard (dropout etc.): the key is
+            # replicated, so fold the device's axis index in
+            if key is not None:
+                key = jax.random.fold_in(key, lax.axis_index(dp))
             if cd is not None:
                 inputs = {k: v.astype(cd)
                           if k in data_names and
@@ -428,23 +442,36 @@ class ShardedTrainer:
                     tot = tot + p_
                 gsum[k] = tot / n_dp
             loss = lax.pmean(loss, dp)
-            auxup = {k: lax.pmean(v, dp) for k, v in (auxup or {}).items()}
+            # emit a value for EVERY aux var so the out_specs pytree
+            # matches even when fn produces no updates (predict mode)
+            auxup = dict(auxup or {})
+            auxup = {k: (lax.pmean(auxup[k], dp) if k in auxup
+                         else aux[k]) for k in aux}
             return loss, gsum, new_res, auxup
 
         rep_tree = lambda t: jax.tree.map(lambda _: PartitionSpec(), t)
-        in_spec_inputs = {n: PartitionSpec(*([None] * batch_axis + [dp]))
+        ndims = getattr(self, "_input_ndims", {})
+
+        def in_spec(name):
+            ax = batch_axis
+            nd = ndims.get(name)
+            if nd is not None and ax >= nd:
+                ax = 0  # lower-rank input (e.g. (B,) labels): dim 0
+            return PartitionSpec(*([None] * ax + [dp]))
+
+        in_spec_inputs = {n: in_spec(n)
                           for n in self._data_names + self._label_names}
-        smapped = shard_map(
-            shard_grads, mesh=mesh,
-            in_specs=(rep_tree(self._params), rep_tree(self._aux),
-                      in_spec_inputs,
-                      jax.tree.map(lambda _: PartitionSpec(dp),
-                                   self._gc_residuals),
-                      PartitionSpec()),
-            out_specs=(PartitionSpec(), rep_tree(self._params),
-                       jax.tree.map(lambda _: PartitionSpec(dp),
-                                    self._gc_residuals),
-                       rep_tree(self._aux)))
+        smapped = shard_map_compat(
+            shard_grads, mesh,
+            (rep_tree(self._params), rep_tree(self._aux),
+             in_spec_inputs,
+             jax.tree.map(lambda _: PartitionSpec(dp),
+                          self._gc_residuals),
+             PartitionSpec()),
+            (PartitionSpec(), rep_tree(self._params),
+             jax.tree.map(lambda _: PartitionSpec(dp),
+                          self._gc_residuals),
+             rep_tree(self._aux)))
 
         def step(params, aux, opt_state, residuals, inputs, key):
             loss, grads, new_res, auxup = smapped(params, aux, inputs,
@@ -471,19 +498,22 @@ class ShardedTrainer:
 
     def step(self, *batch_and_labels):
         """Run one fused train step; returns the scalar loss NDArray."""
+        names = self._data_names + self._label_names
+        if len(batch_and_labels) != len(names):
+            raise MXNetError("step expects %s" % (names,))
+        inputs = {}
+        ndims = {}
+        for n, x in zip(names, batch_and_labels):
+            arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            ndims[n] = arr.ndim
+            inputs[n] = jax.device_put(arr,
+                                       self._batch_sharding(arr.ndim))
         if self._step_fn is None:
+            self._input_ndims = ndims
             if self._grad_compression is not None:
                 self._build_step_compressed()
             else:
                 self._build_step()
-        names = self._data_names + self._label_names
-        if len(batch_and_labels) != len(names):
-            raise MXNetError("step expects %s" % (names,))
-        sh = self._batch_sharding()
-        inputs = {}
-        for n, x in zip(names, batch_and_labels):
-            arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
-            inputs[n] = jax.device_put(arr, sh)
         key = _random.next_key() if self._needs_rng else None
         if self._grad_compression is not None:
             (self._params, self._aux, self._opt_state, self._gc_residuals,
@@ -498,7 +528,11 @@ class ShardedTrainer:
     # -- param sync back to the frontend --------------------------------
     @property
     def params(self):
-        return dict(self._params)
+        """Copies of the current parameters. Copies, not the live
+        arrays: step()/step_many() donate their inputs, so the
+        internal buffers are deleted by the next step."""
+        return {k: jnp.array(v, copy=True)
+                for k, v in self._params.items()}
 
     def copy_params_to_net(self):
         """Write trained values back into the gluon net's Parameters."""

@@ -212,10 +212,15 @@ class DistKVStore(KVStore):
             (self._nproc,) + val.shape, sharding_i,
             [jax.device_put(val[None], mine)])
         rep = NamedSharding(mesh, PartitionSpec())
-        flat = jax.jit(
-            lambda i, v: (i.reshape((-1,)),
-                          v.reshape((-1,) + v.shape[2:])),
-            out_shardings=(rep, rep))
+        # cache the jitted flattener per instance: a fresh jit wrapper
+        # per call would retrace+recompile on every sparse push
+        flat = getattr(self, "_flatten_fn", None)
+        if flat is None:
+            flat = jax.jit(
+                lambda i, v: (i.reshape((-1,)),
+                              v.reshape((-1,) + v.shape[2:])),
+                out_shardings=(rep, rep))
+            self._flatten_fn = flat
         oi, ov = flat(gi, gv)
         return (jnp.asarray(oi.addressable_data(0)),
                 jnp.asarray(ov.addressable_data(0)))

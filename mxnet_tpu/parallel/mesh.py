@@ -28,16 +28,18 @@ __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh",
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
-    """shard_map across jax versions: new API takes check_vma, the
-    experimental one check_rep."""
+    """shard_map across jax versions: new API takes check_vma, older
+    spellings take check_rep (including transition releases where
+    jax.shard_map exists but still uses the old kwarg)."""
+    import inspect
     try:
         from jax import shard_map as _sm
-        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_vma=False)
     except ImportError:
         from jax.experimental.shard_map import shard_map as _sm
-        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_rep=False)
+    kw = ("check_vma" if "check_vma" in
+          inspect.signature(_sm).parameters else "check_rep")
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **{kw: False})
 
 _ACTIVE = []
 

@@ -113,7 +113,8 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh, axis_name="ep", top_k=2,
     if N % n:
         raise ValueError("moe_ffn: %d tokens not divisible by %s=%d"
                          % (N, axis_name, n))
-    C = -(-int(capacity_factor * top_k * (N // n)) // E)  # ceil, >=1
+    import math
+    C = max(1, math.ceil(capacity_factor * top_k * (N // n) / E))
 
     tok = P(axis_name)               # tokens / token-major tensors
     exp = P(axis_name)               # expert-major params

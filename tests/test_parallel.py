@@ -234,3 +234,104 @@ def test_weight_update_sharding_matches_replicated():
     # params remain replicated for compute
     k0 = [k for k in b._params if k.endswith("_weight")][0]
     assert b._params[k0].sharding.spec == P()
+
+
+def test_params_property_survives_next_step():
+    # step() donates internal buffers; the public accessor must return
+    # copies that stay valid afterwards
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import gluon
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(4))
+    net.initialize()
+    net(mx.nd.zeros((1, 3)))
+    loss = gluon.loss.L2Loss()
+    st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        mesh=make_mesh({"dp": 8}))
+    x = np.random.RandomState(0).randn(8, 3).astype("f")
+    y = np.zeros((8, 4), "f")
+    st.step(x, y)
+    snap = st.params
+    st.step(x, y)
+    for v in snap.values():
+        assert np.isfinite(np.asarray(v)).all()  # not deleted
+
+
+def test_sgd_momentum_zero_carries_no_state_and_trains():
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import gluon
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(8, activation="relu"), gnn.Dense(10))
+    net.initialize()
+    net(mx.nd.zeros((1, 4)))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.2},
+                        mesh=make_mesh({"dp": 8}))
+    assert st._opt_state == {}
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype("f")
+    y = (np.arange(16) % 10).astype("f")
+    ls = [float(st.step(x, y).asscalar()) for _ in range(5)]
+    assert ls[-1] < ls[0]
+
+
+def test_batch_axis_one_with_rank1_labels():
+    # TNC-layout data (batch on axis 1) alongside (B,) labels: the label
+    # sharding must clamp to its own rank instead of erroring
+    from mxnet_tpu.gluon import nn as gnn, HybridBlock
+    from mxnet_tpu import gluon
+
+    class MeanDense(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.out = gnn.Dense(10)
+
+        def hybrid_forward(self, F, x):  # x: (T, B, C)
+            return self.out(F.mean(x, axis=0))
+
+    net = MeanDense()
+    net.initialize()
+    net(mx.nd.zeros((5, 2, 4)))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.1}, batch_axis=1,
+                        mesh=make_mesh({"dp": 8}))
+    x = np.random.RandomState(0).randn(5, 16, 4).astype("f")
+    y = (np.arange(16) % 10).astype("f")
+    l = float(st.step(x, y).asscalar())
+    assert np.isfinite(l)
+
+
+def test_compressed_step_predict_mode_and_rng_net():
+    # compressed path with (a) a BN net in predict aux_mode (no aux
+    # updates emitted) and (b) a dropout net (per-shard folded RNG)
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import gluon
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    gc = {"gradient_compression": {"type": "2bit", "threshold": 0.1}}
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 6).astype("f")
+    y = (np.arange(16) % 4).astype("f")
+
+    bn_net = gnn.HybridSequential()
+    bn_net.add(gnn.Dense(8), gnn.BatchNorm(), gnn.Dense(4))
+    bn_net.initialize()
+    bn_net(mx.nd.zeros((1, 6)))
+    st = ShardedTrainer(bn_net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.1}, aux_mode="predict",
+                        mesh=make_mesh({"dp": 8}), **gc)
+    assert np.isfinite(float(st.step(x, y).asscalar()))
+
+    do_net = gnn.HybridSequential()
+    do_net.add(gnn.Dense(8, activation="relu"), gnn.Dropout(0.5),
+               gnn.Dense(4))
+    do_net.initialize()
+    do_net(mx.nd.zeros((1, 6)))
+    st2 = ShardedTrainer(do_net, lambda o, l: loss(o, l), "sgd",
+                         {"learning_rate": 0.1},
+                         mesh=make_mesh({"dp": 8}), **gc)
+    ls = [float(st2.step(x, y).asscalar()) for _ in range(3)]
+    assert all(np.isfinite(v) for v in ls)
