@@ -97,6 +97,12 @@ class TrainerCheckpoint:
                 if k not in raw:
                     restored[k] = tgt  # absent on disk: keep current
                     continue
+                if k == "opt_state" and tgt == {} and \
+                        isinstance(raw[k], dict):
+                    # migration: plain-SGD trainers no longer carry the
+                    # zero-momentum dict older checkpoints saved
+                    restored[k] = {}
+                    continue
                 if (jax.tree.structure(raw[k])
                         != jax.tree.structure(tgt)):
                     raise err

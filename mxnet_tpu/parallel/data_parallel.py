@@ -57,10 +57,13 @@ def sgd_update(params, grads, state, lr=0.01, momentum=0.0, wd=0.0):
         if momentum:
             m = momentum * state[k] + g
             new_s[k] = m
-        else:  # plain SGD carries no state (reference optimizer.py SGD)
+        else:  # plain SGD: no momentum to update
             m = g
         new_p[k] = p - lr * m
-    return new_p, new_s
+    # at momentum=0 the carried state passes through structurally
+    # unchanged (callers may hold a full dict from a schedule that
+    # enables momentum later); ShardedTrainer allocates {} in that case
+    return new_p, (new_s if momentum else state)
 
 
 def adam_init(params):
@@ -240,13 +243,18 @@ class ShardedTrainer:
             jnp.array(value, copy=True),
             NamedSharding(self._mesh, self._spec_for(name)))
 
-    def _batch_sharding(self, ndim=None):
-        """Sharding splitting the batch axis over dp. For arrays of
+    def _batch_axis_for(self, ndim):
+        """Effective batch axis for an input of rank `ndim`: arrays of
         lower rank than batch_axis+1 (e.g. (B,) labels alongside
-        batch_axis=1 TNC data) the batch axis clamps to dim 0."""
+        batch_axis=1 TNC data) batch on dim 0."""
         ax = self._batch_axis
         if ndim is not None and ax >= ndim:
             ax = 0
+        return ax
+
+    def _batch_sharding(self, ndim=None):
+        """Sharding splitting the (rank-clamped) batch axis over dp."""
+        ax = self._batch_axis_for(ndim)
         spec = [None] * (ax + 1)
         spec[ax] = self._dp_axis_name()
         return NamedSharding(self._mesh, PartitionSpec(*spec))
@@ -453,10 +461,7 @@ class ShardedTrainer:
         ndims = getattr(self, "_input_ndims", {})
 
         def in_spec(name):
-            ax = batch_axis
-            nd = ndims.get(name)
-            if nd is not None and ax >= nd:
-                ax = 0  # lower-rank input (e.g. (B,) labels): dim 0
+            ax = self._batch_axis_for(ndims.get(name))
             return PartitionSpec(*([None] * ax + [dp]))
 
         in_spec_inputs = {n: in_spec(n)
@@ -488,7 +493,7 @@ class ShardedTrainer:
         opt_sh = _match_param_shardings(self._opt_state, param_sh, rep)
         res_sh = {n: NamedSharding(self._mesh, PartitionSpec(dp))
                   for n in self._gc_residuals}
-        in_sh = {n: self._batch_sharding()
+        in_sh = {n: self._batch_sharding(ndims.get(n))
                  for n in self._data_names + self._label_names}
         self._step_fn = jax.jit(
             step,

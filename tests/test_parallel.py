@@ -335,3 +335,42 @@ def test_compressed_step_predict_mode_and_rng_net():
                          mesh=make_mesh({"dp": 8}), **gc)
     ls = [float(st2.step(x, y).asscalar()) for _ in range(3)]
     assert all(np.isfinite(v) for v in ls)
+
+
+def test_batch_axis_one_rank1_labels_with_compression():
+    # the compressed path's jit in_shardings must clamp too
+    from mxnet_tpu.gluon import nn as gnn, HybridBlock
+    from mxnet_tpu import gluon
+
+    class MeanDense(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.out = gnn.Dense(10)
+
+        def hybrid_forward(self, F, x):
+            return self.out(F.mean(x, axis=0))
+
+    net = MeanDense()
+    net.initialize()
+    net(mx.nd.zeros((5, 2, 4)))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                        {"learning_rate": 0.1}, batch_axis=1,
+                        mesh=make_mesh({"dp": 8}),
+                        gradient_compression={"type": "2bit",
+                                              "threshold": 0.1})
+    x = np.random.RandomState(0).randn(5, 16, 4).astype("f")
+    y = (np.arange(16) % 10).astype("f")
+    assert np.isfinite(float(st.step(x, y).asscalar()))
+
+
+def test_sgd_update_passes_state_through_at_zero_momentum():
+    from mxnet_tpu.parallel.data_parallel import sgd_update
+    import jax.numpy as jnp
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.full((3,), 0.5)}
+    state = {"w": jnp.zeros((3,))}
+    new_p, new_s = sgd_update(params, grads, state, lr=0.1, momentum=0.0)
+    assert new_s is state  # structure preserved for schedule callers
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 0.95)

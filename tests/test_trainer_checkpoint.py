@@ -155,3 +155,33 @@ def test_restore_across_compression_config_changes(tmp_path):
             np.testing.assert_allclose(np.asarray(plain2._params[k]),
                                        np.asarray(comp2._params[k]),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_old_plain_sgd_checkpoint_restores_into_stateless_trainer(
+        tmp_path):
+    # pre-0.3 checkpoints stored a zero-momentum dict for plain SGD;
+    # restore must migrate (drop it), not crash
+    import jax.numpy as jnp
+    rng = np.random.RandomState(5)
+    net = _net()
+    x, y = _batch(rng)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    a = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                       {"learning_rate": 0.05},
+                       mesh=make_mesh({"dp": 8}))
+    a.step(x, y)
+    assert a._opt_state == {}
+    # simulate the legacy on-disk structure
+    a._opt_state = {k: jnp.zeros_like(v) for k, v in a._params.items()}
+    with TrainerCheckpoint(tmp_path / "old") as ck:
+        ck.save(1, a, wait=True)
+        a._opt_state = {}
+        b = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
+                           {"learning_rate": 0.05},
+                           mesh=make_mesh({"dp": 8}))
+        assert ck.restore_latest(b) == 1
+        assert b._opt_state == {}
+        for k in a._params:
+            np.testing.assert_allclose(np.asarray(b._params[k]),
+                                       np.asarray(a._params[k]),
+                                       rtol=1e-6, atol=1e-7)
