@@ -43,14 +43,67 @@ def _apply_platform_override():
 
 
 def _probe_devices(timeout_s=180):
-    """Fail fast with a diagnosable message when the backend is
-    unreachable (the recorded metric must be a real measurement or a
-    clean error, never a hang)."""
-    from mxnet_tpu.base import probe_devices
-    devs, err = probe_devices(timeout_s)
-    if devs is None:
-        raise SystemExit("bench: device backend unreachable (%s)" % err)
-    return devs
+    """Probe + recovery (the recorded metric must be a real measurement
+    or a clean error, never a hang — and round 3 proved one failed
+    probe shouldn't be the end: recover, then retry).
+
+    Each probe runs in a FRESH interpreter: a PJRT init that timed out
+    leaves this process's jax wedged on the init lock, so an in-process
+    retry can never succeed. Between attempts, reap stale framework
+    processes that may be blocking the device lease (tools/kill_stale.py,
+    the reference kill-mxnet.py role) and back off — relay-side lease
+    wedges clear with time, not force.
+    """
+    import subprocess
+    import sys
+    retries = int(os.environ.get("MXTPU_BENCH_PROBE_RETRIES", 3))
+    waits = (45, 90, 180)
+    plat = os.environ.get("MXTPU_BENCH_PLATFORM")
+    pin = ("import jax; jax.config.update('jax_platforms', %r); " % plat
+           if plat else "")
+    code = (pin + "from mxnet_tpu.base import probe_devices; import sys; "
+            "d, e = probe_devices(%d); "
+            "sys.stderr.write('' if d else str(e)); "
+            "sys.exit(0 if d else 1)" % timeout_s)
+    err = "?"
+    here = os.path.dirname(os.path.abspath(__file__))
+    for attempt in range(max(retries, 1)):
+        try:
+            # belt over the in-child deadline: if the child itself wedges
+            # (e.g. PJRT init stuck in a C call holding the GIL so even
+            # interpreter shutdown hangs), reap it here
+            r = subprocess.run([sys.executable, "-c", code], cwd=here,
+                               capture_output=True, text=True,
+                               timeout=timeout_s + 60)
+        except subprocess.TimeoutExpired:
+            err = "probe child wedged past %ds" % (timeout_s + 60)
+        else:
+            if r.returncode == 0:
+                # do the PARENT's backend init under the same deadline:
+                # this process hasn't attempted init yet, so the probe
+                # both guards and performs it (a wedge in the window
+                # after the child's clean exit would otherwise hang the
+                # unguarded jax.devices() below)
+                from mxnet_tpu.base import probe_devices
+                devs, perr = probe_devices(timeout_s)
+                if devs is not None:
+                    return True
+                raise SystemExit(
+                    "bench: probe child ok but parent init failed (%s)"
+                    % perr)
+            err = ((r.stderr or "").strip().splitlines() or ["?"])[-1]
+        if attempt + 1 >= max(retries, 1):
+            break
+        sys.stderr.write("bench: probe %d failed (%s); cleaning stale "
+                         "processes and retrying\n" % (attempt + 1, err))
+        ks = subprocess.run([sys.executable,
+                             os.path.join(here, "tools", "kill_stale.py"),
+                             "--kill"], capture_output=True, text=True)
+        for line in (ks.stdout + ks.stderr).splitlines():
+            sys.stderr.write("bench:   kill_stale: %s\n" % line)
+        time.sleep(waits[min(attempt, len(waits) - 1)])
+    raise SystemExit("bench: device backend unreachable after %d probes "
+                     "(%s)" % (max(retries, 1), err))
 
 
 def main():

@@ -6,8 +6,10 @@ python/mxnet/ndarray/ndarray.py:169.
 TPU-native design: an NDArray wraps a jax.Array. The reference's async
 semantics (engine var per chunk, WaitToRead/WaitToWrite) are inherited for
 free from JAX's async dispatch — every op returns immediately with a future
--backed buffer and `wait_to_read()` = `block_until_ready()`. The dependency
-engine, storage pool and kernel library are all subsumed by XLA/PJRT.
+-backed buffer and `wait_to_read()` fences via `_fence` (block_until_ready
+plus, on remote/tunneled platforms, a device_get of a dependent slice —
+see _fence's docstring). The dependency engine, storage pool and kernel
+library are all subsumed by XLA/PJRT.
 
 Eager op dispatch (the analog of Imperative::Invoke,
 src/imperative/imperative.cc:87) goes through `invoke()`: per-(op, params)
@@ -39,6 +41,78 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
 # through _live_lock.
 _live_arrays = weakref.WeakSet()
 _live_lock = __import__("threading").Lock()
+
+
+# Platforms where block_until_ready() is NOT a completion fence: the
+# axon relay acks execute RPCs before remote execution finishes
+# (measured in PERF.md §5 — a "58k img/s" impossibility), so only a
+# host fetch of bytes that depend on the buffer truly orders against
+# the producing computation. See docs/faq/env_var.md (MXTPU_STRICT_FENCE).
+_WEAK_FENCE_PLATFORMS = frozenset({"axon"})
+
+
+def _strict_fence_default(data):
+    try:
+        return next(iter(data.devices())).platform in _WEAK_FENCE_PLATFORMS
+    except Exception as e:
+        # fail-open to the weak fence, but never silently: on the one
+        # platform class where the weak fence is the known bug this
+        # would corrupt measurements (PERF.md §5)
+        global _fence_warned
+        if not _fence_warned:
+            _fence_warned = True
+            import warnings
+            warnings.warn("strict-fence platform probe failed (%s); "
+                          "falling back to block_until_ready — set "
+                          "MXTPU_STRICT_FENCE=1 on remote backends" % e)
+        return False
+
+
+_fence_warned = False
+
+
+def _fence(data):
+    """The ONE completion fence for a jax.Array (reference WaitToRead,
+    include/mxnet/ndarray.h:315-323: returns only after all pending
+    writes completed). Shared by NDArray.wait_to_read/wait_to_write and
+    waitall() (which batches via _fence_many).
+
+    block_until_ready() suffices on local backends. Where it is known
+    weak (axon tunnel) — or when forced via MXTPU_STRICT_FENCE=1 — we
+    additionally device_get a tiny dependent slice: the fetched bytes
+    can only exist after the producer ran, so the fetch is a real fence
+    at O(1) transfer cost. Non-addressable (multi-process sharded)
+    buffers can't be fetched from one host and keep the weak fence.
+    """
+    _fence_many([data])
+
+
+def _fence_many(datas):
+    """Fence a batch of jax.Arrays with ONE host round trip for the
+    strict leg (device_get takes a pytree), so a waitall() over
+    hundreds of live arrays doesn't pay per-array tunnel latency."""
+    from ..base import getenv
+    strict = getenv("MXTPU_STRICT_FENCE", None)
+    forced = (None if strict is None
+              else str(strict) not in ("0", "false", "False", ""))
+    slices = []
+    for data in datas:
+        if not isinstance(data, jax.Array):
+            continue
+        if isinstance(data, jax.core.Tracer):
+            continue  # inside a trace there is nothing to fence (and
+            # device_get on a tracer would raise ConcretizationTypeError)
+        if getattr(data, "is_deleted", lambda: False)():
+            continue  # donated buffer: its producer has completed
+        data.block_until_ready()
+        want = _strict_fence_default(data) if forced is None else forced
+        if (want and data.size
+                and getattr(data, "is_fully_addressable", True)):
+            # one-ELEMENT slice (O(1) device work — not ravel, which
+            # would materialize a full reshaped copy per fence)
+            slices.append(data[(0,) * data.ndim])
+    if slices:
+        jax.device_get(slices)
 
 
 class NDArray:
@@ -121,7 +195,7 @@ class NDArray:
         return self.asnumpy().tolist()
 
     def wait_to_read(self):
-        self._data.block_until_ready()
+        _fence(self._data)
 
     wait_to_write = wait_to_read
 
@@ -584,12 +658,7 @@ def waitall():
     effectful computations, and drains the native host engine."""
     with _live_lock:
         snapshot = list(_live_arrays)
-    for arr in snapshot:
-        data = arr._data
-        if isinstance(data, jax.Array):
-            if getattr(data, "is_deleted", lambda: False)():
-                continue  # donated buffer: its producer has completed
-            data.block_until_ready()
+    _fence_many([arr._data for arr in snapshot])
     jax.effects_barrier()
     from .. import engine as _engine
     _engine._waitall_native()

@@ -255,3 +255,51 @@ def test_gather_scatter():
     assert g.asnumpy().tolist() == [1, 6]
     s = nd.scatter_nd(nd.array([9.0, 8.0]), idx, shape=(3, 3))
     assert s.asnumpy()[0, 1] == 9 and s.asnumpy()[2, 0] == 8
+
+
+def test_strict_fence(monkeypatch):
+    """wait_to_read/wait_to_write/waitall share ONE fence (_fence), and
+    strict mode device_gets a dependent slice — the only reliable fence
+    on remote/tunneled backends where block_until_ready can return
+    before remote execution completes (docs/faq/env_var.md,
+    MXTPU_STRICT_FENCE; reference WaitToRead semantics,
+    include/mxnet/ndarray.h:315)."""
+    import jax
+    from mxnet_tpu.ndarray import ndarray as nd_mod
+
+    gets = []
+    real_get = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: (gets.append(1), real_get(x))[1])
+
+    monkeypatch.setenv("MXTPU_STRICT_FENCE", "1")
+    a = nd.ones((4, 4)) * 3
+    a.wait_to_read()
+    assert len(gets) == 1          # one tiny dependent-slice fetch
+    assert a.asnumpy()[0, 0] == 3  # value untouched by the fence
+    a.wait_to_write()
+    assert len(gets) == 2
+
+    n_before = len(gets)
+    nd.waitall()
+    assert len(gets) > n_before    # waitall fences strictly too
+
+    # scalars and empty arrays fence without error
+    nd.array(7.0).wait_to_read()
+    nd.zeros((0, 3)).wait_to_read()
+
+    # forced off: no device_get
+    monkeypatch.setenv("MXTPU_STRICT_FENCE", "0")
+    n = len(gets)
+    a.wait_to_read()
+    assert len(gets) == n
+
+    # both user entry points route through the shared implementation
+    # (_fence_many; waitall batches its strict leg into one device_get)
+    fenced = []
+    monkeypatch.setattr(nd_mod, "_fence_many",
+                        lambda ds: fenced.extend(id(d) for d in ds))
+    a.wait_to_read()
+    assert fenced == [id(a._data)]
+    nd.waitall()
+    assert fenced.count(id(a._data)) >= 2

@@ -43,6 +43,16 @@ def main():
               % ([str(d) for d in devs], time.time() - t0))
     else:
         print("devices      : UNAVAILABLE (%s)" % err)
+        print("  recovery   : python tools/kill_stale.py --kill  "
+              "(reaps init-hung holders; relay-side lease wedges "
+              "clear with time — retry with backoff)")
+        try:
+            from tools.kill_stale import find_candidates
+            for c in find_candidates():
+                print("  suspect    : pid %d age %.0fs %s"
+                      % (c["pid"], c["age_s"], c["cmd"][:80]))
+        except Exception as e:  # /proc-less host: keep the report going
+            print("  suspects   : unavailable (%s)" % e)
 
     print("----------Deps----------")
     for name in ("numpy", "flax", "optax", "orbax.checkpoint", "PIL",
