@@ -740,7 +740,49 @@ def _rnn(key, data, params, state, *rest, state_size, num_layers,
 @register("Correlation")
 def _correlation(a, b, *, kernel_size=1, max_displacement=1, stride1=1,
                  stride2=1, pad_size=0, is_multiply=True):
-    raise MXNetError("Correlation: not implemented yet")
+    """Patch cross-correlation between two feature maps, NCHW
+    (reference: src/operator/correlation.cc — the FlowNet op; output
+    channel q is the displacement (dy, dx), value = mean over channels
+    and the K×K window of a·shift(b) — or |a−b| when is_multiply=0).
+
+    TPU design: the displacement grid is a static unroll (D² ≤ ~25
+    slices of one padded buffer); the K×K patch sum is one
+    reduce_window per displacement, so everything lowers to fused
+    XLA window ops instead of the reference's per-pixel CUDA kernel.
+    """
+    n, c, h, w = a.shape
+    k, rad = int(kernel_size), (int(kernel_size) - 1) // 2
+    md, s2 = int(max_displacement), int(stride2)
+    # output geometry uses the FULL max_displacement; the displacement
+    # grid uses multiples of stride2 within radius md//s2 (reference
+    # correlation.cc: neighborhood_grid_radius_ = max_displacement_ /
+    # stride2_ — indivisible remainders round DOWN)
+    reach = (md // s2) * s2
+    border = md + rad
+    hp, wp = h + 2 * pad_size, w + 2 * pad_size
+    out_h = -(-(hp - 2 * border) // stride1)  # ceil, like the reference
+    out_w = -(-(wp - 2 * border) // stride1)
+    if out_h <= 0 or out_w <= 0:
+        raise MXNetError("Correlation: displacement+kernel exceed input")
+    pa = jnp.pad(a, ((0, 0), (0, 0), (pad_size, pad_size),
+                     (pad_size, pad_size)))
+    # extra md of padding so every static displacement is a plain slice
+    pb = jnp.pad(b, ((0, 0), (0, 0), (pad_size + md, pad_size + md),
+                     (pad_size + md, pad_size + md)))
+    norm = k * k * c
+    planes = []
+    for dy in range(-reach, reach + 1, s2):
+        for dx in range(-reach, reach + 1, s2):
+            shifted = pb[:, :, md + dy:md + dy + hp,
+                         md + dx:md + dx + wp]
+            prod = pa * shifted if is_multiply else jnp.abs(pa - shifted)
+            # channel sum then K×K window sum = patch aggregate
+            plane = lax.reduce_window(prod.sum(axis=1),
+                                      jnp.zeros((), prod.dtype), lax.add,
+                                      (1, k, k), (1, 1, 1), "VALID")
+            planes.append(plane[:, md:md + out_h * stride1:stride1,
+                                md:md + out_w * stride1:stride1])
+    return jnp.stack(planes, axis=1) / norm
 
 
 @register("IdentityAttachKLSparseReg")

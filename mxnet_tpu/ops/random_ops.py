@@ -102,7 +102,6 @@ def _sample_normal(key, mu, sigma, *, shape=(), dtype=None):
 def _sample_multinomial(key, data, *, shape=(), get_prob=False,
                         dtype="int32"):
     """data: (..., k) probabilities; samples category indices."""
-    n = int(jnp.asarray(shape).prod()) if shape else 1
     shp = tuple(shape) if shape else ()
     logits = jnp.log(jnp.maximum(data, 1e-37))
     flatshape = data.shape[:-1] + shp

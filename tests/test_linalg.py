@@ -1,0 +1,152 @@
+"""Oracle tests for the linalg_* operator family vs numpy.linalg.
+
+Reference: src/operator/tensor/la_op.cc (linalg_gemm/gemm2/potrf/potri/
+trmm/trsm/syrk/sumlogdiag/syevd/gelqf); test breadth model:
+tests/python/unittest/test_operator.py (the reference exercises every
+registered op at least once — this file closes the linalg gap found in
+round 3's audit).
+
+Conventions under test (mxnet semantics):
+  potrf(A)    = lower Cholesky factor of SPD A
+  potri(L)    = A^-1 given L = potrf(A)
+  gemm        = alpha*op(A)op(B) + beta*C
+  gemm2       = alpha*op(A)op(B)
+  syrk        = alpha*A·Aᵀ (transpose=False) / alpha*Aᵀ·A
+  trmm        = alpha*tri(A)·B (rightside/transpose variants)
+  trsm        solves tri(A)·X = alpha*B (and variants)
+  sumlogdiag  = sum(log(diag(A)))
+  syevd       = (U, w) with A = Uᵀ·diag(w)·U, rows of U eigenvectors
+  gelqf       = (L, Q) with A = L·Q, Q orthonormal rows
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(42)
+
+
+def spd(n, batch=()):
+    b = RNG.randn(*batch, n, n).astype("float64")
+    a = np.matmul(b, np.swapaxes(b, -1, -2)) + n * np.eye(n)
+    return a.astype("float32")
+
+
+def test_potrf_vs_numpy():
+    for batch in [(), (3,)]:
+        a = spd(5, batch)
+        l = nd.linalg_potrf(nd.array(a)).asnumpy()
+        ref = np.linalg.cholesky(a.astype("float64"))
+        assert np.allclose(l, ref, atol=1e-3)
+        # lower-triangular by construction
+        assert np.allclose(l, np.tril(l), atol=1e-6)
+
+
+def test_potri_is_inverse():
+    a = spd(4)
+    l = nd.linalg_potrf(nd.array(a))
+    inv = nd.linalg_potri(l).asnumpy()
+    assert np.allclose(inv, np.linalg.inv(a.astype("float64")), atol=1e-3)
+
+
+def test_gemm_family():
+    a = RNG.randn(3, 4).astype("float32")
+    b = RNG.randn(4, 5).astype("float32")
+    c = RNG.randn(3, 5).astype("float32")
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=-1.0).asnumpy()
+    assert np.allclose(out, 2.0 * a @ b - c, atol=1e-5)
+    # transposed operands
+    out = nd.linalg_gemm(nd.array(a.T), nd.array(b.T), nd.array(c),
+                         transpose_a=True, transpose_b=True).asnumpy()
+    assert np.allclose(out, a @ b + c, atol=1e-5)
+    out2 = nd.linalg_gemm2(nd.array(a), nd.array(b), alpha=0.5).asnumpy()
+    assert np.allclose(out2, 0.5 * a @ b, atol=1e-5)
+
+
+def test_syrk():
+    a = RNG.randn(3, 5).astype("float32")
+    assert np.allclose(nd.linalg_syrk(nd.array(a), alpha=1.5).asnumpy(),
+                       1.5 * a @ a.T, atol=1e-5)
+    assert np.allclose(
+        nd.linalg_syrk(nd.array(a), transpose=True).asnumpy(),
+        a.T @ a, atol=1e-5)
+
+
+def test_trmm_trsm_roundtrip():
+    n = 4
+    a = np.tril(RNG.randn(n, n)).astype("float32") + 3 * np.eye(
+        n, dtype="float32")
+    b = RNG.randn(n, 3).astype("float32")
+    # trmm computes tri(A)@B; trsm must undo it
+    prod = nd.linalg_trmm(nd.array(a), nd.array(b), alpha=2.0)
+    assert np.allclose(prod.asnumpy(), 2.0 * np.tril(a) @ b, atol=1e-5)
+    back = nd.linalg_trsm(nd.array(a), prod, alpha=0.5).asnumpy()
+    assert np.allclose(back, b, atol=1e-4)
+    # rightside: B@tri(A); and its solve
+    br = RNG.randn(3, n).astype("float32")
+    pr = nd.linalg_trmm(nd.array(a), nd.array(br), rightside=True)
+    assert np.allclose(pr.asnumpy(), br @ np.tril(a), atol=1e-5)
+    xr = nd.linalg_trsm(nd.array(a), pr, rightside=True).asnumpy()
+    assert np.allclose(xr, br, atol=1e-4)
+    # transpose: tri(A)ᵀ X = B  <=>  X = tri(A)^-ᵀ B
+    xt = nd.linalg_trsm(nd.array(a), nd.array(b), transpose=True).asnumpy()
+    assert np.allclose(np.tril(a).T @ xt, b, atol=1e-4)
+
+
+def test_sumlogdiag():
+    a = spd(4)
+    out = nd.linalg_sumlogdiag(nd.array(a)).asnumpy()
+    assert np.allclose(out, np.log(np.diag(a)).sum(), atol=1e-5)
+
+
+def test_syevd_vs_numpy():
+    a = spd(5)
+    u, w = nd.linalg_syevd(nd.array(a))
+    u, w = u.asnumpy(), w.asnumpy()
+    w_ref = np.linalg.eigvalsh(a.astype("float64"))
+    assert np.allclose(np.sort(w), np.sort(w_ref), atol=1e-3)
+    # rows of U are eigenvectors: A = Uᵀ diag(w) U
+    rec = u.T @ np.diag(w) @ u
+    assert np.allclose(rec, a, atol=1e-3)
+    # orthonormality
+    assert np.allclose(u @ u.T, np.eye(5), atol=1e-4)
+
+
+def test_gelqf():
+    a = RNG.randn(3, 6).astype("float32")  # m <= n
+    q, l = nd.linalg_gelqf(nd.array(a))  # mxnet order: (Q, L), A = L·Q
+    l, q = l.asnumpy(), q.asnumpy()
+    assert np.allclose(l @ q, a, atol=1e-4)       # A = L·Q
+    assert np.allclose(l, np.tril(l), atol=1e-5)  # L lower-triangular
+    assert np.allclose(q @ q.T, np.eye(3), atol=1e-4)  # orthonormal rows
+
+
+def test_linalg_symbol_and_grad():
+    """linalg ops compose in graphs and differentiate correctly
+    (numeric-grad check, mxnet_tpu/test_utils.py:170 checker pattern)."""
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.sum(mx.sym.linalg_gemm2(a, b, alpha=1.5))
+    check_numeric_gradient(
+        out, {"a": RNG.randn(3, 4).astype("float32"),
+              "b": RNG.randn(4, 2).astype("float32")})
+
+    # potrf grad on an SPD input
+    av = spd(3)
+    out = mx.sym.sum(mx.sym.linalg_sumlogdiag(mx.sym.linalg_potrf(a)))
+    check_numeric_gradient(out, {"a": av}, rtol=2e-2, atol=1e-2)
+
+
+def test_linalg_batched():
+    """Batch dims broadcast through the whole family (XLA batches the
+    underlying lax ops; the reference loops per-matrix in la_op.cc)."""
+    a = spd(4, (2, 3))
+    l = nd.linalg_potrf(nd.array(a)).asnumpy()
+    assert l.shape == (2, 3, 4, 4)
+    ref = np.linalg.cholesky(a.astype("float64"))
+    assert np.allclose(l, ref, atol=1e-3)
+    s = nd.linalg_sumlogdiag(nd.array(a)).asnumpy()
+    assert s.shape == (2, 3)

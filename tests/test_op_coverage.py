@@ -1,0 +1,833 @@
+"""Registry-wide operator coverage: the audit gate + the smoke/oracle
+cases that close it.
+
+Round-3 finding: 374 registered ops but no proof each is exercised.
+The reference keeps breadth honest with one gigantic test file
+(tests/python/unittest/test_operator.py, 6,785 LoC); the TPU-native
+equivalent is this gate:
+
+  test_registry_audit — every op in registry.list_ops() must be
+  (a) named somewhere in the test corpus (word match over tests/*.py),
+  (b) share its fn with a named op (alias closure),
+  (c) have a CASES entry here (executed by test_case below), or
+  (d) appear in CREDIT (covered by a named test under a frontend
+      spelling) or EXEMPT (justified, kept tiny).
+
+CASES are not mere smokes where an independent numpy oracle is cheap:
+elementwise/scalar/broadcast families all assert exact values; LRN /
+UpSampling / Correlation / count_sketch / Deconvolution get dedicated
+oracle tests below (reference: src/operator/correlation.cc, lrn.cc,
+nn/upsampling.cc, contrib/count_sketch.cc, nn/deconvolution.cc).
+"""
+import glob
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.ndarray import invoke
+from mxnet_tpu.ops import registry as R
+
+RNG = np.random.RandomState(3)
+
+
+def run(name, arrays, params=None):
+    outs = invoke(R.get(name), [nd.array(a) for a in arrays],
+                  dict(params or {}))
+    return [o.asnumpy() for o in outs]
+
+
+def ocheck(out, exp, atol=1e-4):
+    out = np.asarray(out, dtype="float64")
+    exp = np.asarray(exp, dtype="float64")
+    assert out.shape == exp.shape, (out.shape, exp.shape)
+    assert np.allclose(out, exp, atol=atol, rtol=1e-4)
+
+
+CASES = {}
+
+
+def case(name):
+    def deco(fn):
+        assert name not in CASES, name
+        CASES[name] = fn
+        return fn
+    return deco
+
+
+def table_case(name, fn):
+    assert name not in CASES, name
+    CASES[name] = fn
+
+
+# ---------------------------------------------------------------------------
+# scalar elementwise (reference: elemwise_binary_scalar_op_basic.cc)
+# ---------------------------------------------------------------------------
+_X = RNG.rand(3, 4).astype("float32") + 0.5  # positive: safe for mod/pow
+_S = 2.5
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_PlusScalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_MinusScalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_MulScalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_DivScalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: np.mod(x, s),
+    "_rmod_scalar": lambda x, s: np.mod(s, x),
+    "_power_scalar": lambda x, s: np.power(x, s),
+    "_rpower_scalar": lambda x, s: np.power(s, x),
+    "_maximum_scalar": lambda x, s: np.maximum(x, s),
+    "_minimum_scalar": lambda x, s: np.minimum(x, s),
+    "_hypot_scalar": lambda x, s: np.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s),
+    "_not_equal_scalar": lambda x, s: (x != s),
+    "_greater_scalar": lambda x, s: (x > s),
+    "_greater_equal_scalar": lambda x, s: (x >= s),
+    "_lesser_scalar": lambda x, s: (x < s),
+    "_lesser_equal_scalar": lambda x, s: (x <= s),
+    "_logical_and_scalar": lambda x, s: np.logical_and(x, s),
+    "_logical_or_scalar": lambda x, s: np.logical_or(x, s),
+    "_logical_xor_scalar": lambda x, s: np.logical_xor(x != 0, s != 0),
+    "_scatter_plus_scalar": lambda x, s: x + s,
+    "_scatter_minus_scalar": lambda x, s: x - s,
+}
+for _n, _f in _SCALAR.items():
+    if _n in R.list_ops():
+        table_case(_n, lambda n=_n, f=_f: ocheck(
+            run(n, [_X], {"scalar": _S})[0], f(_X, _S)))
+
+# ---------------------------------------------------------------------------
+# binary (broadcast) elementwise
+# ---------------------------------------------------------------------------
+_A = RNG.rand(3, 4).astype("float32") + 0.5
+_B = RNG.rand(3, 4).astype("float32") + 0.5
+_B1 = RNG.rand(1, 4).astype("float32") + 0.5  # broadcasting rhs
+
+_BINARY = {
+    "_mod": (lambda a, b: np.mod(a, b), _B),
+    "_grad_add": (lambda a, b: a + b, _B),
+    "_equal": (lambda a, b: a == b, _A),       # equal on same array: 1s
+    "_not_equal": (lambda a, b: a != b, _B),
+    "_greater": (lambda a, b: a > b, _B),
+    "_greater_equal": (lambda a, b: a >= b, _B),
+    "_lesser": (lambda a, b: a < b, _B),
+    "_lesser_equal": (lambda a, b: a <= b, _B),
+    "broadcast_mod": (lambda a, b: np.mod(a, b), _B1),
+    "broadcast_equal": (lambda a, b: a == b, _B1),
+    "broadcast_not_equal": (lambda a, b: a != b, _B1),
+    "broadcast_greater": (lambda a, b: a > b, _B1),
+    "broadcast_greater_equal": (lambda a, b: a >= b, _B1),
+    "broadcast_lesser": (lambda a, b: a < b, _B1),
+    "broadcast_lesser_equal": (lambda a, b: a <= b, _B1),
+    "broadcast_logical_and": (lambda a, b: np.logical_and(a, b), _B1),
+    "broadcast_logical_or": (lambda a, b: np.logical_or(a, b), _B1),
+    "broadcast_logical_xor": (
+        lambda a, b: np.logical_xor(a != 0, b != 0), _B1),
+    "_scatter_elemwise_div": (lambda a, b: a / b, _B),
+}
+for _n, (_f, _rhs) in _BINARY.items():
+    table_case(_n, lambda n=_n, f=_f, rhs=_rhs: ocheck(
+        run(n, [_A, rhs])[0], f(_A, rhs)))
+
+# ---------------------------------------------------------------------------
+# unary elementwise / reductions
+# ---------------------------------------------------------------------------
+_U = (RNG.rand(3, 4).astype("float32") - 0.5) * 1.6  # in (-0.8, 0.8)
+
+
+def _softmin(x):
+    e = np.exp(-x - (-x).max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+_UNARY = {
+    "fix": np.fix,
+    "floor": np.floor,
+    "rint": np.rint,
+    "trunc": np.trunc,
+    "degrees": np.degrees,
+    "radians": np.radians,
+    "logical_not": np.logical_not,
+    "ones_like": np.ones_like,
+    "softmin": _softmin,
+    "cumsum": lambda x: np.cumsum(x, axis=None).astype("float32"),
+    "logsumexp": lambda x: np.log(np.exp(x).sum()),
+    "nanprod": lambda x: np.nanprod(x),
+    "shape_array": lambda x: np.array(x.shape, dtype="int64"),
+    "size_array": lambda x: np.array([x.size], dtype="int64"),
+    "smooth_l1": lambda x: np.where(np.abs(x) < 1, 0.5 * x * x,
+                                    np.abs(x) - 0.5),
+    "_contrib_div_sqrt_dim": lambda x: x / np.sqrt(x.shape[-1]),
+}
+for _n, _f in _UNARY.items():
+    table_case(_n, lambda n=_n, f=_f: ocheck(run(n, [_U])[0], f(_U)))
+
+
+@case("erfinv")
+def _case_erfinv():
+    out = run("erfinv", [_U])[0]
+    back = np.vectorize(math.erf)(out.astype("float64"))
+    ocheck(back, _U, atol=1e-3)
+
+
+@case("diag")
+def _case_diag():
+    m = RNG.rand(4, 4).astype("float32")
+    ocheck(run("diag", [m])[0], np.diag(m))
+    ocheck(run("diag", [m], {"k": 1})[0], np.diag(m, k=1))
+
+
+@case("argmax_channel")
+def _case_argmax_channel():
+    m = RNG.rand(5, 7).astype("float32")
+    ocheck(run("argmax_channel", [m])[0], m.argmax(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+@case("_ones")
+def _case_ones():
+    ocheck(run("_ones", [], {"shape": (2, 3)})[0], np.ones((2, 3)))
+
+
+@case("_zeros")
+def _case_zeros():
+    ocheck(run("_zeros", [], {"shape": (2, 3)})[0], np.zeros((2, 3)))
+
+
+@case("ones_op")
+def _case_ones_op():
+    ocheck(run("ones_op", [], {"shape": (4,)})[0], np.ones((4,)))
+
+
+@case("zeros_op")
+def _case_zeros_op():
+    ocheck(run("zeros_op", [], {"shape": (4,)})[0], np.zeros((4,)))
+
+
+@case("_full")
+def _case_full():
+    ocheck(run("_full", [], {"shape": (2, 2), "value": 7.0})[0],
+           np.full((2, 2), 7.0))
+
+
+@case("_arange")
+def _case_arange():
+    ocheck(run("_arange", [], {"start": 2.0, "stop": 8.0, "step": 1.5})[0],
+           np.arange(2.0, 8.0, 1.5, dtype="float32"))
+
+
+@case("khatri_rao")
+def _case_khatri_rao():
+    a = RNG.rand(2, 3).astype("float32")
+    b = RNG.rand(4, 3).astype("float32")
+    exp = np.stack([np.kron(a[:, i], b[:, i]) for i in range(3)], axis=1)
+    ocheck(run("khatri_rao", [a, b])[0], exp)
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing ops
+# ---------------------------------------------------------------------------
+@case("broadcast_axis")
+def _case_broadcast_axis():
+    x = RNG.rand(1, 4).astype("float32")
+    ocheck(run("broadcast_axis", [x], {"axis": 0, "size": 3})[0],
+           np.broadcast_to(x, (3, 4)))
+
+
+@case("broadcast_like")
+def _case_broadcast_like():
+    x = RNG.rand(1, 4).astype("float32")
+    y = np.zeros((5, 4), "float32")
+    ocheck(run("broadcast_like", [x, y])[0], np.broadcast_to(x, (5, 4)))
+
+
+@case("reshape_like")
+def _case_reshape_like():
+    x = RNG.rand(2, 6).astype("float32")
+    ocheck(run("reshape_like", [x, np.zeros((3, 4), "float32")])[0],
+           x.reshape(3, 4))
+
+
+@case("slice_like")
+def _case_slice_like():
+    x = RNG.rand(4, 6).astype("float32")
+    ocheck(run("slice_like", [x, np.zeros((2, 3), "float32")])[0],
+           x[:2, :3])
+
+
+@case("pick")
+def _case_pick():
+    x = RNG.rand(4, 5).astype("float32")
+    idx = np.array([0, 2, 4, 1], "float32")
+    ocheck(run("pick", [x, idx])[0],
+           x[np.arange(4), idx.astype(int)])
+
+
+@case("batch_take")
+def _case_batch_take():
+    x = RNG.rand(4, 5).astype("float32")
+    idx = np.array([1, 0, 3, 2], "float32")
+    ocheck(run("batch_take", [x, idx])[0],
+           x[np.arange(4), idx.astype(int)])
+
+
+@case("cast_storage")
+def _case_cast_storage():
+    x = RNG.rand(3, 3).astype("float32")
+    ocheck(run("cast_storage", [x], {"stype": "default"})[0], x)
+
+
+@case("depth_to_space")
+def _case_depth_space():
+    x = RNG.rand(2, 8, 3, 3).astype("float32")
+    d2s = run("depth_to_space", [x], {"block_size": 2})[0]
+    assert d2s.shape == (2, 2, 6, 6)
+    back = run("space_to_depth", [d2s], {"block_size": 2})[0]
+    ocheck(back, x)  # exact roundtrip pins both layouts
+
+
+CASES["space_to_depth"] = _case_depth_space
+
+
+@case("ravel_multi_index")
+def _case_ravel():
+    idx = np.array([[1, 2, 0], [3, 1, 4]], "float32")  # (ndim=2, n)
+    out = run("ravel_multi_index", [idx], {"shape": (4, 5)})[0]
+    ocheck(out, np.ravel_multi_index(idx.astype(int), (4, 5)))
+    back = run("unravel_index", [out], {"shape": (4, 5)})[0]
+    ocheck(back, idx)
+
+
+CASES["unravel_index"] = _case_ravel
+
+
+@case("_slice_assign_scalar")
+def _case_slice_assign_scalar():
+    x = np.zeros((4, 4), "float32")
+    out = run("_slice_assign_scalar", [x],
+              {"scalar": 5.0, "begin": (1, 1), "end": (3, 3)})[0]
+    exp = x.copy()
+    exp[1:3, 1:3] = 5.0
+    ocheck(out, exp)
+
+
+@case("_crop_assign_scalar")
+def _case_crop_assign_scalar():
+    x = np.ones((3, 3), "float32")
+    out = run("_crop_assign_scalar", [x],
+              {"scalar": -1.0, "begin": (0, 0), "end": (2, 2)})[0]
+    exp = x.copy()
+    exp[:2, :2] = -1.0
+    ocheck(out, exp)
+
+
+@case("_scatter_set_nd")
+def _case_scatter_set_nd():
+    lhs = np.zeros((3, 3), "float32")
+    indices = np.array([[0, 2], [1, 0]], "float32")  # (ndim, n)
+    rhs = np.array([9.0, 8.0], "float32")
+    out = run("_scatter_set_nd", [lhs, indices, rhs],
+              {"shape": (3, 3)})[0]
+    exp = lhs.copy()
+    exp[0, 1] = 9.0
+    exp[2, 0] = 8.0
+    ocheck(out, exp)
+
+
+@case("_identity_with_attr_like_rhs")
+def _case_identity_like_rhs():
+    a = RNG.rand(3,).astype("float32")
+    ocheck(run("_identity_with_attr_like_rhs",
+               [a, np.zeros((3,), "float32")])[0], a)
+
+
+@case("_CrossDeviceCopy")
+def _case_cross_device_copy():
+    a = RNG.rand(2, 2).astype("float32")
+    ocheck(run("_CrossDeviceCopy", [a])[0], a)
+
+
+@case("add_n")
+def _case_add_n():
+    xs = [RNG.rand(2, 3).astype("float32") for _ in range(3)]
+    ocheck(run("add_n", xs, {"num_args": 3})[0], sum(xs))
+    ocheck(run("ElementWiseSum", xs, {"num_args": 3})[0], sum(xs))
+
+
+CASES["ElementWiseSum"] = _case_add_n
+
+
+@case("_sparse_retain")
+def _case_sparse_retain():
+    x = RNG.rand(5, 3).astype("float32")
+    out = run("_sparse_retain", [x, np.array([0, 3], "float32")])[0]
+    exp = np.zeros_like(x)
+    exp[[0, 3]] = x[[0, 3]]
+    ocheck(out, exp)
+
+
+# ---------------------------------------------------------------------------
+# legacy nn heads / normalizers (reference: src/operator/*-inl.h)
+# ---------------------------------------------------------------------------
+@case("LinearRegressionOutput")
+def _case_linreg():
+    d = RNG.rand(4, 3).astype("float32")
+    lbl = RNG.rand(4, 3).astype("float32")
+    ocheck(run("LinearRegressionOutput", [d, lbl])[0], d)  # fwd=identity
+
+
+@case("MAERegressionOutput")
+def _case_mae():
+    d = RNG.rand(4, 3).astype("float32")
+    ocheck(run("MAERegressionOutput", [d, np.zeros_like(d)])[0], d)
+
+
+@case("LogisticRegressionOutput")
+def _case_logistic():
+    d = _U
+    ocheck(run("LogisticRegressionOutput", [d, np.zeros_like(d)])[0],
+           1.0 / (1.0 + np.exp(-d)))
+
+
+@case("MakeLoss")
+def _case_makeloss():
+    ocheck(run("MakeLoss", [_X])[0], _X)
+    ocheck(run("make_loss", [_X])[0], _X)
+
+
+CASES["make_loss"] = _case_makeloss
+
+
+@case("IdentityAttachKLSparseReg")
+def _case_kl_reg():
+    ocheck(run("IdentityAttachKLSparseReg", [_X])[0], _X)
+
+
+@case("SoftmaxActivation")
+def _case_softmax_act():
+    d = RNG.rand(4, 5).astype("float32")
+    e = np.exp(d - d.max(-1, keepdims=True))
+    ocheck(run("SoftmaxActivation", [d])[0], e / e.sum(-1, keepdims=True))
+
+
+@case("LeakyReLU")
+def _case_leaky():
+    d = _U
+    ocheck(run("LeakyReLU", [d], {"act_type": "leaky", "slope": 0.1})[0],
+           np.where(d > 0, d, 0.1 * d))
+
+
+@case("InstanceNorm")
+def _case_instancenorm():
+    d = RNG.rand(2, 3, 4, 4).astype("float32")
+    gamma = np.ones((3,), "float32")
+    beta = np.zeros((3,), "float32")
+    out = run("InstanceNorm", [d, gamma, beta], {"eps": 1e-5})[0]
+    mean = d.mean(axis=(2, 3), keepdims=True)
+    var = d.var(axis=(2, 3), keepdims=True)
+    ocheck(out, (d - mean) / np.sqrt(var + 1e-5), atol=1e-3)
+
+
+@case("L2Normalization")
+def _case_l2norm():
+    d = RNG.rand(3, 8).astype("float32")
+    norm = np.sqrt((d * d).sum(axis=1, keepdims=True) + 1e-10)
+    ocheck(run("L2Normalization", [d])[0], d / norm)
+
+
+# ---------------------------------------------------------------------------
+# random samplers: domain/shape checks (values are PRNG-dependent)
+# ---------------------------------------------------------------------------
+def _sampler_case(name, params, check):
+    def _run():
+        out = run(name, [], dict(params, shape=(200,)))[0]
+        assert out.shape == (200,)
+        assert np.isfinite(out.astype("float64")).all()
+        assert check(out), name
+    return _run
+
+
+for _n, _p, _c in [
+    ("_random_exponential", {"lam": 2.0}, lambda o: (o >= 0).all()),
+    ("_random_gamma", {"alpha": 3.0, "beta": 1.0}, lambda o: (o > 0).all()),
+    ("_random_poisson", {"lam": 4.0},
+     lambda o: (o >= 0).all() and np.allclose(o, np.round(o))),
+    ("_random_negative_binomial", {"k": 3, "p": 0.5},
+     lambda o: (o >= 0).all() and np.allclose(o, np.round(o))),
+    ("_random_generalized_negative_binomial",
+     {"mu": 2.0, "alpha": 0.5}, lambda o: (o >= 0).all()),
+    ("bernoulli", {"prob": 0.3},
+     lambda o: set(np.unique(o)) <= {0.0, 1.0}),
+]:
+    table_case(_n, _sampler_case(_n, _p, _c))
+    plain = _n.lstrip("_")
+    if plain != _n and plain in R.list_ops() and plain not in CASES:
+        table_case(plain, _sampler_case(plain, _p, _c))
+
+
+@case("_sample_normal")
+def _case_sample_normal():
+    mu = np.array([0.0, 100.0], "float32")
+    sigma = np.array([1.0, 1.0], "float32")
+    out = run("_sample_normal", [mu, sigma], {"shape": (500,)})[0]
+    assert out.shape == (2, 500)
+    assert abs(out[0].mean()) < 1.0 and abs(out[1].mean() - 100.0) < 1.0
+
+
+CASES["sample_normal"] = _case_sample_normal
+
+
+@case("_sample_uniform")
+def _case_sample_uniform():
+    low = np.array([0.0, 10.0], "float32")
+    high = np.array([1.0, 20.0], "float32")
+    out = run("_sample_uniform", [low, high], {"shape": (300,)})[0]
+    assert out.shape == (2, 300)
+    assert (out[0] >= 0).all() and (out[0] <= 1).all()
+    assert (out[1] >= 10).all() and (out[1] <= 20).all()
+
+
+CASES["sample_uniform"] = _case_sample_uniform
+
+
+@case("_sample_generalized_negative_binomial")
+def _case_sample_gnb():
+    mu = np.array([2.0], "float32")
+    alpha = np.array([0.5], "float32")
+    out = run("_sample_generalized_negative_binomial", [mu, alpha],
+              {"shape": (100,)})[0]
+    assert out.shape == (1, 100) and (out >= 0).all()
+
+
+@case("_sample_multinomial")
+def _case_sample_multinomial():
+    probs = np.array([[0.1, 0.0, 0.9], [0.5, 0.5, 0.0]], "float32")
+    out = run("_sample_multinomial", [probs], {"shape": (50,)})[0]
+    assert out.shape == (2, 50)
+    assert (out[0] != 1).all() and (out[1] != 2).all()  # zero-prob bins
+    assert ((out >= 0) & (out <= 2)).all()
+
+
+CASES["sample_multinomial"] = _case_sample_multinomial
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update ops
+# ---------------------------------------------------------------------------
+@case("ftml_update")
+def _case_ftml():
+    w = RNG.rand(4,).astype("float32")
+    g = RNG.rand(4,).astype("float32")
+    z = np.zeros((4,), "float32")
+    outs = run("ftml_update", [w, g, z.copy(), z.copy(), z.copy()],
+               {"lr": 0.1, "t": 1})
+    assert len(outs) >= 1 and outs[0].shape == w.shape
+    assert np.isfinite(outs[0]).all() and not np.allclose(outs[0], w)
+
+
+@case("mp_sgd_mom_update")
+def _case_mp_sgd():
+    w32 = RNG.rand(4,).astype("float32")
+    w16 = w32.astype("float16")
+    g = np.ones((4,), "float16")
+    mom = np.zeros((4,), "float32")
+    outs = run("mp_sgd_mom_update", [w16, g, mom, w32],
+               {"lr": 0.1, "momentum": 0.9})
+    # plain SGD step 1: w - lr*g (momentum buffer starts at 0)
+    ocheck(outs[0].astype("float32"), (w32 - 0.1).astype("float16"),
+           atol=1e-2)
+
+
+@case("rmspropalex_update")
+def _case_rmspropalex():
+    w = RNG.rand(4,).astype("float32")
+    g = RNG.rand(4,).astype("float32")
+    z = np.zeros((4,), "float32")
+    outs = run("rmspropalex_update", [w, g, z.copy(), z.copy(), z.copy()],
+               {"lr": 0.05})
+    assert np.isfinite(outs[0]).all() and not np.allclose(outs[0], w)
+
+
+@case("_sparse_adagrad_update")
+def _case_sparse_adagrad():
+    w = RNG.rand(4, 2).astype("float32")
+    g = RNG.rand(4, 2).astype("float32")
+    h = np.zeros((4, 2), "float32")
+    outs = run("_sparse_adagrad_update", [w, g, h], {"lr": 0.1})
+    assert np.isfinite(outs[0]).all() and not np.allclose(outs[0], w)
+
+
+# ---------------------------------------------------------------------------
+# int8 tail (quantize/dequantize/requantize cores are in test_int8.py)
+# ---------------------------------------------------------------------------
+@case("_contrib_quantized_act")
+def _case_quantized_act():
+    d = ((RNG.rand(2, 4) - 0.5) * 254).astype("int8").astype("float32")
+    mn, mx_ = np.array([-1.0], "float32"), np.array([1.0], "float32")
+    out, omin, omax = run("_contrib_quantized_act", [d, mn, mx_])
+    ocheck(out, np.maximum(d, 0))
+    assert float(omin[0]) == 0.0 and float(omax[0]) == 1.0
+
+
+@case("_contrib_quantized_flatten")
+def _case_quantized_flatten():
+    d = RNG.rand(2, 3, 4).astype("float32")
+    mn, mx_ = np.array([-1.0], "float32"), np.array([1.0], "float32")
+    out, omin, omax = run("_contrib_quantized_flatten", [d, mn, mx_])
+    ocheck(out, d.reshape(2, 12))
+    assert float(omin[0]) == -1.0 and float(omax[0]) == 1.0
+
+
+@case("_contrib_quantized_fully_connected")
+def _case_quantized_fc():
+    d = ((RNG.rand(2, 3) - 0.5) * 100).astype("int8")
+    w = ((RNG.rand(4, 3) - 0.5) * 100).astype("int8")
+    b = np.zeros((4,), "int8")
+    rng_ = np.array([-1.0], "float32"), np.array([1.0], "float32")
+    outs = run("_contrib_quantized_fully_connected",
+               [d, w, b, rng_[0], rng_[1], rng_[0], rng_[1],
+                rng_[0], rng_[1]], {"num_hidden": 4})
+    # int8×int8 accumulates exactly in int32
+    ocheck(outs[0].astype("float64"),
+           d.astype("int32") @ w.astype("int32").T)
+
+
+@case("_contrib_requantize")
+def _case_requantize():
+    d = np.array([[1000, -2000, 30000]], "float32")  # int32 domain
+    mn = np.array([-3.0], "float32")
+    mx_ = np.array([3.0], "float32")
+    out, omin, omax = run("_contrib_requantize", [d, mn, mx_],
+                          {"min_calib_range": -1.0,
+                           "max_calib_range": 1.0})
+    assert out.dtype == np.int8 or np.abs(out).max() <= 127
+
+
+@case("_contrib_int8_fc")
+def _case_int8_fc():
+    d = RNG.rand(2, 3).astype("float32")
+    w = RNG.rand(4, 3).astype("float32")
+    out = run("_contrib_int8_fc", [d, w],
+              {"amax_data": 1.0, "num_hidden": 4})[0]
+    # int8-simulated fc ≈ fp32 fc within quantization error
+    ocheck(out, d @ w.T, atol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# control flow op nodes: exercised through the SYMBOL frontends (the
+# registered _foreach/_while_loop/_cond graphs are what sym.contrib
+# builds — see also test_control_flow.py's eager+symbol suites)
+# ---------------------------------------------------------------------------
+@case("_foreach")
+def _case_foreach_sym():
+    d = mx.sym.var("d")
+    s = mx.sym.var("s")
+    outs, states = mx.sym.contrib.foreach(
+        lambda x, st: (x + st[0], [st[0] + 1]), d, [s])
+    ex = outs.simple_bind(mx.cpu(), d=(3, 2), s=(2,))
+    dv = RNG.rand(3, 2).astype("float32")
+    out = ex.forward(d=nd.array(dv), s=nd.zeros((2,)))[0].asnumpy()
+    ocheck(out, dv + np.arange(3)[:, None])
+
+
+@case("_while_loop")
+def _case_while_sym():
+    s = mx.sym.var("s")
+    outs, states = mx.sym.contrib.while_loop(
+        lambda st: mx.sym.sum(st[0]) < 10,
+        lambda st: ([st[0]], [st[0] + 1]),
+        [s], max_iterations=20)
+    ex = states[0].simple_bind(mx.cpu(), s=(1,))
+    out = ex.forward(s=nd.zeros((1,)))[0].asnumpy()
+    assert float(out[0]) == 10.0
+
+
+@case("_cond")
+def _case_cond_sym():
+    p = mx.sym.var("p")
+    x = mx.sym.var("x")
+    out = mx.sym.contrib.cond(p > 0, lambda: x * 2, lambda: x - 1)
+    ex = out.simple_bind(mx.cpu(), p=(1,), x=(3,))
+    xv = RNG.rand(3).astype("float32")
+    o1 = ex.forward(p=nd.ones((1,)), x=nd.array(xv))[0].asnumpy()
+    ocheck(o1, xv * 2)
+    o2 = ex.forward(p=nd.zeros((1,)) - 1, x=nd.array(xv))[0].asnumpy()
+    ocheck(o2, xv - 1)
+
+
+# ---------------------------------------------------------------------------
+# dedicated oracle tests (round-3 audit's named gaps)
+# ---------------------------------------------------------------------------
+def test_lrn_oracle():
+    """LRN vs a direct numpy implementation of the reference formula
+    (src/operator/lrn.cc): out = x / (knorm + alpha/n * sum_win x²)^beta."""
+    x = RNG.rand(2, 7, 3, 3).astype("float32")
+    nsize, alpha, beta, knorm = 5, 1e-2, 0.75, 2.0
+    out = run("LRN", [x], {"nsize": nsize, "alpha": alpha, "beta": beta,
+                           "knorm": knorm})[0]
+    exp = np.empty_like(x)
+    half = nsize // 2
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + half + 1)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        exp[:, c] = x[:, c] / (knorm + alpha / nsize * acc) ** beta
+    ocheck(out, exp, atol=1e-4)
+
+
+def test_upsampling_oracle():
+    """UpSampling nearest vs np.repeat (reference nn/upsampling.cc)."""
+    x = RNG.rand(2, 3, 4, 4).astype("float32")
+    out = run("UpSampling", [x], {"scale": 2})[0]
+    ocheck(out, x.repeat(2, axis=2).repeat(2, axis=3))
+
+
+def test_correlation_oracle():
+    """Correlation vs a naive displacement/patch loop (reference
+    src/operator/correlation.cc semantics)."""
+    n, c, h, w = 1, 2, 8, 8
+    a = RNG.rand(n, c, h, w).astype("float32")
+    b = RNG.rand(n, c, h, w).astype("float32")
+    k, d, s1, s2, pad = 3, 2, 1, 1, 2
+    out = run("Correlation", [a, b],
+              {"kernel_size": k, "max_displacement": d, "stride1": s1,
+               "stride2": s2, "pad_size": pad})[0]
+    rad = (k - 1) // 2
+    border = d + rad
+    hp, wp = h + 2 * pad, w + 2 * pad
+    out_h = -(-(hp - 2 * border) // s1)
+    out_w = -(-(wp - 2 * border) // s1)
+    pa = np.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    pb = np.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    reach = (d // s2) * s2  # reference grid-radius convention
+    disp = [(dy, dx) for dy in range(-reach, reach + 1, s2)
+            for dx in range(-reach, reach + 1, s2)]
+    exp = np.zeros((n, len(disp), out_h, out_w), "float32")
+    for q, (dy, dx) in enumerate(disp):
+        for u in range(out_h):
+            for v in range(out_w):
+                i0, j0 = border + u * s1, border + v * s1
+                pa_patch = pa[:, :, i0 - rad:i0 + rad + 1,
+                              j0 - rad:j0 + rad + 1]
+                pb_patch = pb[:, :, i0 + dy - rad:i0 + dy + rad + 1,
+                              j0 + dx - rad:j0 + dx + rad + 1]
+                exp[:, q, u, v] = (pa_patch * pb_patch).sum(
+                    axis=(1, 2, 3)) / (k * k * c)
+    assert out.shape == exp.shape
+    ocheck(out, exp, atol=1e-4)
+    # abs-difference mode
+    out2 = run("Correlation", [a, b],
+               {"kernel_size": 1, "max_displacement": 1, "pad_size": 1,
+                "is_multiply": False})[0]
+    assert out2.shape[1] == 9 and (out2 >= 0).all()
+    # indivisible max_displacement rounds the grid DOWN (reference:
+    # neighborhood_grid_radius = max_displacement // stride2) while the
+    # output geometry keeps the full displacement border
+    out3 = run("Correlation", [a, b],
+               {"kernel_size": 1, "max_displacement": 3, "stride2": 2,
+                "pad_size": 3})[0]
+    assert out3.shape[1] == 9  # grid {-2,0,2}² not {-3,-1,1,3}²
+
+
+def test_count_sketch_oracle():
+    """count_sketch vs a scatter-add loop (contrib/count_sketch.cc)."""
+    bsz, in_dim, out_dim = 3, 10, 6
+    data = RNG.rand(bsz, in_dim).astype("float32")
+    h = RNG.randint(0, out_dim, size=(in_dim,)).astype("float32")
+    s = (RNG.randint(0, 2, size=(in_dim,)) * 2 - 1).astype("float32")
+    out = run("_contrib_count_sketch", [data, h, s],
+              {"out_dim": out_dim})[0]
+    exp = np.zeros((bsz, out_dim), "float32")
+    for j in range(in_dim):
+        exp[:, int(h[j])] += s[j] * data[:, j]
+    ocheck(out, exp)
+
+
+def test_deconvolution_oracle():
+    """Deconvolution vs a naive transposed-conv loop (weight layout
+    (in_channels, num_filter, kH, kW) — nn/deconvolution.cc)."""
+    n, cin, cout, h, w, k = 1, 2, 3, 4, 4, 3
+    x = RNG.rand(n, cin, h, w).astype("float32")
+    wt = RNG.rand(cin, cout, k, k).astype("float32")
+    out = run("Deconvolution", [x, wt],
+              {"kernel": (k, k), "num_filter": cout})[0]
+    exp = np.zeros((n, cout, h + k - 1, w + k - 1), "float32")
+    for c in range(cin):
+        for f in range(cout):
+            for y in range(h):
+                for xx in range(w):
+                    exp[:, f, y:y + k, xx:xx + k] += (
+                        x[:, c, y, xx, None, None] * wt[c, f])
+    assert out.shape == exp.shape
+    ocheck(out, exp, atol=1e-3)
+    # stride-2 output size follows the reference formula
+    out2 = run("Deconvolution", [x, wt],
+               {"kernel": (k, k), "num_filter": cout, "stride": (2, 2)})[0]
+    assert out2.shape == (n, cout, 2 * (h - 1) + k, 2 * (w - 1) + k)
+
+
+CASES["Correlation"] = test_correlation_oracle
+CASES["_contrib_count_sketch"] = test_count_sketch_oracle
+CASES["LRN"] = test_lrn_oracle
+CASES["UpSampling"] = test_upsampling_oracle
+CASES["Deconvolution"] = test_deconvolution_oracle
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+# covered by a named test under a frontend spelling (each entry names
+# the proof so the claim is checkable)
+CREDIT = {}
+
+# justified exemptions — keep under 10 (round-3 audit target)
+EXEMPT = {
+    # none currently: every registered op is exercised somewhere.
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_case(name):
+    CASES[name]()
+
+
+def test_registry_audit():
+    """Every registered op is exercised by at least one test: named in
+    the corpus, alias of a named op, CASES here, or CREDIT/EXEMPT."""
+    corpus = ""
+    here = os.path.dirname(os.path.abspath(__file__))
+    for f in glob.glob(os.path.join(here, "*.py")):
+        if os.path.basename(f) == "test_op_coverage.py":
+            continue
+        with open(f) as fh:
+            corpus += fh.read()
+    words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", corpus))
+
+    def name_covered(n):
+        if n in words or n in CASES or n in CREDIT or n in EXEMPT:
+            return True
+        return (n.startswith("_contrib_")
+                and n[len("_contrib_"):] in words)
+
+    ops = sorted(R.list_ops())
+    fams = {}
+    for n in ops:
+        fams.setdefault(id(R.get(n).fn), []).append(n)
+    missing = []
+    for names in fams.values():
+        if not any(name_covered(n) for n in names):
+            missing.extend(names)
+    assert not missing, (
+        "untested ops (add a CASES entry in test_op_coverage.py): %s"
+        % sorted(missing))
+    assert len(EXEMPT) < 10
