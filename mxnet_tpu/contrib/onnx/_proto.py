@@ -1,0 +1,446 @@
+"""Self-contained ONNX protobuf codec (no `onnx`/`protobuf` dependency).
+
+Reference role: the reference delegates serialization to the `onnx`
+package (python/mxnet/contrib/onnx/mx2onnx/export_onnx.py imports
+onnx.helper). That package isn't available in this environment, so the
+TPU-native port carries its own minimal codec for the stable, public
+onnx.proto schema (github.com/onnx/onnx/blob/main/onnx/onnx.proto) —
+just the messages the converters need: ModelProto, GraphProto,
+NodeProto, AttributeProto, TensorProto, ValueInfoProto, TypeProto,
+TensorShapeProto, OperatorSetIdProto.
+
+Wire format: standard protobuf — varint-keyed fields, length-delimited
+submessages/strings, packed or unpacked repeated scalars (the parser
+accepts both; the encoder emits packed, like protoc).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# TensorProto.DataType (onnx.proto enum)
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+
+NP2ONNX = {
+    np.dtype("float32"): FLOAT, np.dtype("uint8"): UINT8,
+    np.dtype("int8"): INT8, np.dtype("uint16"): UINT16,
+    np.dtype("int16"): INT16, np.dtype("int32"): INT32,
+    np.dtype("int64"): INT64, np.dtype("bool"): BOOL,
+    np.dtype("float16"): FLOAT16, np.dtype("float64"): DOUBLE,
+    np.dtype("uint32"): UINT32, np.dtype("uint64"): UINT64,
+}
+ONNX2NP = {v: k for k, v in NP2ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------------------
+# wire-level primitives
+# ---------------------------------------------------------------------------
+def _varint(n):
+    n &= (1 << 64) - 1  # two's-complement negatives, like protobuf
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _field(num, wire, payload):
+    return _varint((num << 3) | wire) + payload
+
+
+def f_varint(num, value):
+    return _field(num, 0, _varint(value))
+
+
+def f_bytes(num, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _field(num, 2, _varint(len(data)) + data)
+
+
+def f_packed_i64(num, values):
+    payload = b"".join(_varint(v) for v in values)
+    return _field(num, 2, _varint(len(payload)) + payload) if values else b""
+
+
+def f_packed_f32(num, values):
+    payload = struct.pack("<%df" % len(values), *values)
+    return _field(num, 2, _varint(len(payload)) + payload) if values else b""
+
+
+def iter_fields(buf):
+    """Yield (field_number, wire_type, value) over a message payload.
+    wire 0 -> int varint; wire 2 -> bytes; wire 5 -> 4-byte; wire 1 ->
+    8-byte."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        num, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError("onnx parse: unsupported wire type %d" % wire)
+        yield num, wire, val
+
+
+def _unpack_scalars(wire, val, fmt, size):
+    """A repeated scalar field arrives either packed (wire 2) or as one
+    element per tag (wire 5/1/0)."""
+    if wire == 2:
+        return list(struct.unpack("<%d%s" % (len(val) // size, fmt), val))
+    return list(struct.unpack("<" + fmt, val))
+
+
+def _unpack_varints(wire, val, signed=True):
+    conv = _signed if signed else (lambda x: x)
+    if wire == 2:
+        out, pos = [], 0
+        while pos < len(val):
+            v, pos = _read_varint(val, pos)
+            out.append(conv(v))
+        return out
+    return [conv(val)]
+
+
+# ---------------------------------------------------------------------------
+# message classes (encode + classmethod parse)
+# ---------------------------------------------------------------------------
+class Tensor:
+    """TensorProto: named constant data."""
+
+    def __init__(self, name="", array=None):
+        self.name = name
+        self.array = array
+
+    def encode(self):
+        a = np.ascontiguousarray(self.array)
+        if a.dtype not in NP2ONNX:
+            raise ValueError("onnx: unsupported dtype %s" % a.dtype)
+        out = f_packed_i64(1, list(a.shape))
+        out += f_varint(2, NP2ONNX[a.dtype])
+        out += f_bytes(8, self.name)
+        out += f_bytes(9, a.tobytes())  # raw_data
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        dims, dtype, name = [], FLOAT, ""
+        raw = None
+        f32, i32, i64, f64 = [], [], [], []
+        for num, wire, val in iter_fields(buf):
+            if num == 1:
+                dims.extend(_unpack_varints(wire, val))
+            elif num == 2:
+                dtype = val
+            elif num == 8:
+                name = val.decode("utf-8")
+            elif num == 9:
+                raw = val
+            elif num == 4:
+                f32.extend(_unpack_scalars(wire, val, "f", 4))
+            elif num == 5:
+                i32.extend(_unpack_varints(wire, val))
+            elif num == 7:
+                i64.extend(_unpack_varints(wire, val))
+            elif num == 10:
+                f64.extend(_unpack_scalars(wire, val, "d", 8))
+        np_dtype = ONNX2NP.get(dtype, np.dtype("float32"))
+        if raw is not None:
+            arr = np.frombuffer(raw, dtype=np_dtype).reshape(dims)
+        elif f32:
+            arr = np.asarray(f32, "float32").reshape(dims)
+        elif f64:
+            arr = np.asarray(f64, "float64").reshape(dims)
+        elif i64:
+            arr = np.asarray(i64, "int64").reshape(dims)
+        elif i32:
+            # int32_data also carries int8/16/bool/fp16 payloads; fp16
+            # entries are raw BIT PATTERNS, not numeric values
+            a = np.asarray(i32, "int32")
+            if np_dtype == np.dtype("float16"):
+                arr = a.astype("uint16").view("float16").reshape(dims)
+            else:
+                arr = a.astype(np_dtype).reshape(dims)
+        else:
+            arr = np.zeros(dims, np_dtype)
+        t = cls(name, arr.astype(np_dtype, copy=False))
+        return t
+
+
+class Attr:
+    """AttributeProto: one typed attribute."""
+
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def encode(self):
+        out = f_bytes(1, self.name)
+        v = self.value
+        if isinstance(v, bool):
+            out += _field(3, 0, _varint(int(v))) + f_varint(20, A_INT)
+        elif isinstance(v, int):
+            out += f_varint(3, v) + f_varint(20, A_INT)
+        elif isinstance(v, float):
+            out += _field(2, 5, struct.pack("<f", v)) + f_varint(20, A_FLOAT)
+        elif isinstance(v, (str, bytes)):
+            out += f_bytes(4, v) + f_varint(20, A_STRING)
+        elif isinstance(v, Tensor):
+            out += f_bytes(5, v.encode()) + f_varint(20, A_TENSOR)
+        elif isinstance(v, (list, tuple)):
+            if v and isinstance(v[0], float):
+                out += f_packed_f32(7, list(v)) + f_varint(20, A_FLOATS)
+            elif v and isinstance(v[0], (str, bytes)):
+                for s in v:
+                    out += f_bytes(9, s)
+                out += f_varint(20, A_STRINGS)
+            else:
+                out += f_packed_i64(8, [int(x) for x in v])
+                out += f_varint(20, A_INTS)
+        else:
+            raise ValueError("onnx attr %r: unsupported %r" % (self.name, v))
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        name, atype = "", None
+        f = i = s = t = None
+        floats, ints, strings = [], [], []
+        for num, wire, val in iter_fields(buf):
+            if num == 1:
+                name = val.decode("utf-8")
+            elif num == 2:
+                f = struct.unpack("<f", val)[0]
+            elif num == 3:
+                i = _signed(val)
+            elif num == 4:
+                s = val
+            elif num == 5:
+                t = Tensor.parse(val)
+            elif num == 7:
+                floats.extend(_unpack_scalars(wire, val, "f", 4))
+            elif num == 8:
+                ints.extend(_unpack_varints(wire, val))
+            elif num == 9:
+                strings.append(val)
+            elif num == 20:
+                atype = val
+        # proto3 writers omit zero-valued scalars from the wire: fall
+        # back to the typed default when only `type` arrived
+        if atype == A_FLOAT or (atype is None and f is not None):
+            return cls(name, f if f is not None else 0.0)
+        if atype == A_INT or (atype is None and i is not None):
+            return cls(name, i if i is not None else 0)
+        if atype == A_STRING or (atype is None and s is not None):
+            return cls(name, (s or b"").decode("utf-8", "replace"))
+        if atype == A_TENSOR or (atype is None and t is not None):
+            return cls(name, t)
+        if atype == A_FLOATS or floats:
+            return cls(name, floats)
+        if atype == A_STRINGS or strings:
+            return cls(name, [x.decode("utf-8", "replace")
+                              for x in strings])
+        return cls(name, ints)
+
+
+class Node:
+    """NodeProto."""
+
+    def __init__(self, op_type, inputs, outputs, name="", attrs=None):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def encode(self):
+        out = b"".join(f_bytes(1, x) for x in self.inputs)
+        out += b"".join(f_bytes(2, x) for x in self.outputs)
+        out += f_bytes(3, self.name)
+        out += f_bytes(4, self.op_type)
+        for k in sorted(self.attrs):
+            out += f_bytes(5, Attr(k, self.attrs[k]).encode())
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        node = cls("", [], [])
+        for num, wire, val in iter_fields(buf):
+            if num == 1:
+                node.inputs.append(val.decode("utf-8"))
+            elif num == 2:
+                node.outputs.append(val.decode("utf-8"))
+            elif num == 3:
+                node.name = val.decode("utf-8")
+            elif num == 4:
+                node.op_type = val.decode("utf-8")
+            elif num == 5:
+                a = Attr.parse(val)
+                node.attrs[a.name] = a.value
+        return node
+
+
+class ValueInfo:
+    """ValueInfoProto with a tensor TypeProto (elem_type + shape)."""
+
+    def __init__(self, name, elem_type=FLOAT, shape=None):
+        self.name = name
+        self.elem_type = elem_type
+        self.shape = shape  # list of int or str(dim_param) or None
+
+    def encode(self):
+        shape_payload = b""
+        for d in (self.shape or ()):
+            if isinstance(d, str):
+                dim = f_bytes(2, d)
+            else:
+                dim = f_varint(1, int(d))
+            shape_payload += f_bytes(1, dim)
+        tensor_type = f_varint(1, self.elem_type)
+        if self.shape is not None:
+            tensor_type += f_bytes(2, shape_payload)
+        type_proto = f_bytes(1, tensor_type)
+        return f_bytes(1, self.name) + f_bytes(2, type_proto)
+
+    @classmethod
+    def parse(cls, buf):
+        vi = cls("", FLOAT, None)
+        for num, wire, val in iter_fields(buf):
+            if num == 1:
+                vi.name = val.decode("utf-8")
+            elif num == 2:
+                for n2, w2, v2 in iter_fields(val):
+                    if n2 != 1:  # tensor_type only
+                        continue
+                    for n3, w3, v3 in iter_fields(v2):
+                        if n3 == 1:
+                            vi.elem_type = v3
+                        elif n3 == 2:
+                            dims = []
+                            for n4, w4, v4 in iter_fields(v3):
+                                if n4 != 1:
+                                    continue
+                                dv = None
+                                for n5, w5, v5 in iter_fields(v4):
+                                    if n5 == 1:
+                                        dv = _signed(v5)
+                                    elif n5 == 2:
+                                        dv = v5.decode("utf-8")
+                                dims.append(dv)
+                            vi.shape = dims
+        return vi
+
+
+class Graph:
+    """GraphProto."""
+
+    def __init__(self, name="graph"):
+        self.name = name
+        self.nodes = []
+        self.initializers = []  # Tensor
+        self.inputs = []        # ValueInfo
+        self.outputs = []       # ValueInfo
+
+    def encode(self):
+        out = b"".join(f_bytes(1, n.encode()) for n in self.nodes)
+        out += f_bytes(2, self.name)
+        out += b"".join(f_bytes(5, t.encode()) for t in self.initializers)
+        out += b"".join(f_bytes(11, v.encode()) for v in self.inputs)
+        out += b"".join(f_bytes(12, v.encode()) for v in self.outputs)
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        g = cls()
+        for num, wire, val in iter_fields(buf):
+            if num == 1:
+                g.nodes.append(Node.parse(val))
+            elif num == 2:
+                g.name = val.decode("utf-8")
+            elif num == 5:
+                g.initializers.append(Tensor.parse(val))
+            elif num == 11:
+                g.inputs.append(ValueInfo.parse(val))
+            elif num == 12:
+                g.outputs.append(ValueInfo.parse(val))
+        return g
+
+
+class Model:
+    """ModelProto (ir_version 8, default opset 13)."""
+
+    def __init__(self, graph, opset=13, producer="mxnet_tpu"):
+        self.graph = graph
+        self.opset = opset
+        self.producer = producer
+        self.ir_version = 8
+
+    def encode(self):
+        out = f_varint(1, self.ir_version)
+        out += f_bytes(2, self.producer)
+        out += f_bytes(7, self.graph.encode())
+        out += f_bytes(8, f_bytes(1, "") + f_varint(2, self.opset))
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        graph, opset, producer = None, 13, ""
+        for num, wire, val in iter_fields(buf):
+            if num == 7:
+                graph = Graph.parse(val)
+            elif num == 8:
+                for n2, w2, v2 in iter_fields(val):
+                    if n2 == 2:
+                        opset = v2
+            elif num == 2:
+                producer = val.decode("utf-8", "replace")
+        if graph is None:
+            raise ValueError("onnx parse: no graph in model")
+        m = cls(graph, opset, producer)
+        return m
+
+
+def save(model, path):
+    with open(path, "wb") as f:
+        f.write(model.encode())
+
+
+def load(path):
+    with open(path, "rb") as f:
+        return Model.parse(f.read())

@@ -1,6 +1,13 @@
 """Symbol -> ONNX export.
 
-Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py.
+Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py and the
+~90 translators in mx2onnx/_op_translations.py (1,929 LoC). The
+TPU-native port serializes through the self-contained codec in
+`_proto.py` (the `onnx` pip package is not required), targets opset 13,
+and covers the whole model zoo: conv/deconv/FC/BN/LRN/pooling
+(incl. global), every zoo activation, shape ops, scalar arithmetic,
+reductions, Pad/Clip/Slice/Split/Resize, and the inference forms of
+the *Output training heads.
 """
 from __future__ import annotations
 
@@ -9,61 +16,486 @@ import numpy as np
 from ...base import MXNetError
 from ...symbol import Symbol
 from ... import symbol as sym_mod
+from . import _proto as P
 
 __all__ = ["export_model"]
 
-# mxnet op name -> (onnx op type, param translator)
-_MX2ONNX = {
-    "FullyConnected": ("Gemm", lambda p: {"alpha": 1.0, "beta": 1.0,
-                                          "transB": 1}),
-    "Convolution": ("Conv", lambda p: {
-        "kernel_shape": list(p.get("kernel", ())),
-        "strides": list(p.get("stride") or
-                        [1] * len(p.get("kernel", ()))),
-        "pads": list(p.get("pad") or [0] * len(p.get("kernel", ()))) * 2,
-        "dilations": list(p.get("dilate") or
-                          [1] * len(p.get("kernel", ()))),
-        "group": int(p.get("num_group", 1))}),
-    "Activation": ("__act__", None),
-    "Pooling": ("__pool__", None),
-    "BatchNorm": ("BatchNormalization",
-                  lambda p: {"epsilon": float(p.get("eps", 1e-3)),
-                             "momentum": float(p.get("momentum", 0.9))}),
-    "Flatten": ("Flatten", lambda p: {"axis": 1}),
-    "softmax": ("Softmax", lambda p: {"axis": int(p.get("axis", -1))}),
-    "SoftmaxOutput": ("Softmax", lambda p: {"axis": 1}),
-    "elemwise_add": ("Add", lambda p: {}),
-    "broadcast_add": ("Add", lambda p: {}),
-    "elemwise_mul": ("Mul", lambda p: {}),
-    "broadcast_mul": ("Mul", lambda p: {}),
-    "Concat": ("Concat", lambda p: {"axis": int(p.get("dim", 1))}),
-    "Dropout": ("Dropout", lambda p: {"ratio": float(p.get("p", 0.5))}),
-    "Reshape": ("__reshape__", None),
-    "transpose": ("Transpose",
-                  lambda p: {"perm": list(p.get("axes", ()))}),
-}
 
-# ops whose trailing label input must be dropped on export (the ONNX
-# form is inference-only)
+# ops whose trailing label input is dropped on export (ONNX is the
+# inference form; reference _op_translations.py does the same)
 _DROP_LABEL_INPUT = {"SoftmaxOutput", "LinearRegressionOutput",
                      "LogisticRegressionOutput", "MAERegressionOutput"}
 
 _ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-             "softrelu": "Softplus"}
+             "softrelu": "Softplus", "softsign": "Softsign"}
+
+_SIMPLE_UNARY = {
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "exp": "Exp",
+    "log": "Log", "sqrt": "Sqrt", "abs": "Abs", "negative": "Neg",
+    "floor": "Floor", "ceil": "Ceil", "erf": "Erf", "round": "Round",
+    "sign": "Sign", "reciprocal": "Reciprocal", "softsign": "Softsign",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "arcsin": "Asin",
+    "arccos": "Acos", "arctan": "Atan", "_copy": "Identity",
+    "BlockGrad": "Identity", "identity": "Identity",
+    "LinearRegressionOutput": "Identity",  # inference form
+    "MAERegressionOutput": "Identity",
+    "LogisticRegressionOutput": "Sigmoid",
+    "Flatten": "Flatten",
+}
+
+_SIMPLE_BINARY = {
+    "elemwise_add": "Add", "broadcast_add": "Add", "_add": "Add",
+    "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_sub": "Sub",
+    "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
+    "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div",
+    "broadcast_maximum": "Max", "_maximum": "Max",
+    "broadcast_minimum": "Min", "_minimum": "Min",
+    "broadcast_power": "Pow", "_power": "Pow",
+    "dot": "MatMul", "batch_dot": "MatMul",
+}
+
+# mx scalar op -> (onnx op, scalar comes first)
+_SCALAR_OPS = {
+    "_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+    "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+    "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+    "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+    "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+}
+
+# reductions with axes as an ATTRIBUTE in opset 13
+_REDUCE_ATTR = {"mean": "ReduceMean", "max": "ReduceMax",
+                "min": "ReduceMin", "prod": "ReduceProd"}
+
+HANDLERS = {}
 
 
+def _handler(*names):
+    def deco(fn):
+        for n in names:
+            HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Accumulates ONNX nodes/initializers during a single export."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.force_ones = set()  # fix_gamma: export gamma as ones
+        self._n = 0
+
+    def emit(self, op_type, ins, outs, name=None, **attrs):
+        self._n += 1
+        self.nodes.append(P.Node(
+            op_type, ins, outs, name or "%s_%d" % (op_type, self._n),
+            attrs))
+
+    def const(self, name, arr):
+        self.initializers.append(P.Tensor(name, np.asarray(arr)))
+        return name
+
+
+def _ints(seq):
+    return [int(x) for x in seq]
+
+
+def _conv_attrs(p, nd):
+    k = _ints(p.get("kernel", ()))
+    return {
+        "kernel_shape": k,
+        "strides": _ints(p.get("stride") or [1] * nd),
+        "pads": _ints(p.get("pad") or [0] * nd) * 2,
+        "dilations": _ints(p.get("dilate") or [1] * nd),
+        "group": int(p.get("num_group", 1)),
+    }
+
+
+@_handler("Convolution")
+def _conv(ctx, node, ins, outs, p):
+    nd = len(p.get("kernel", ()))
+    if p.get("layout") not in (None, "NCHW", "NCW", "NCDHW"):
+        raise MXNetError("ONNX export: Convolution layout %r (ONNX is "
+                         "channels-first; export the NCHW variant)"
+                         % p["layout"])
+    ctx.emit("Conv", ins, outs, node.name, **_conv_attrs(p, nd))
+
+
+@_handler("Deconvolution")
+def _deconv(ctx, node, ins, outs, p):
+    nd = len(p.get("kernel", ()))
+    attrs = _conv_attrs(p, nd)
+    adj = p.get("adj")
+    if adj:
+        attrs["output_padding"] = _ints(adj)
+    ctx.emit("ConvTranspose", ins, outs, node.name, **attrs)
+
+
+@_handler("FullyConnected")
+def _fc(ctx, node, ins, outs, p):
+    data = ins[0]
+    if p.get("flatten", True):
+        flat = node.name + "_flat"
+        ctx.emit("Flatten", [data], [flat], axis=1)
+        data = flat
+        ctx.emit("Gemm", [data] + ins[1:], outs, node.name,
+                 alpha=1.0, beta=1.0, transB=1)
+    else:
+        # contract over the last axis: MatMul with Wᵀ (+ bias)
+        wt = node.name + "_wT"
+        ctx.emit("Transpose", [ins[1]], [wt], perm=[1, 0])
+        if len(ins) > 2:
+            mm = node.name + "_mm"
+            ctx.emit("MatMul", [data, wt], [mm])
+            ctx.emit("Add", [mm, ins[2]], outs, node.name)
+        else:
+            ctx.emit("MatMul", [data, wt], outs, node.name)
+
+
+@_handler("Activation")
+def _act(ctx, node, ins, outs, p):
+    act = p.get("act_type", "relu")
+    if act not in _ACT2ONNX:
+        raise MXNetError("ONNX export: Activation %r" % act)
+    ctx.emit(_ACT2ONNX[act], ins, outs, node.name)
+
+
+@_handler("LeakyReLU")
+def _leaky(ctx, node, ins, outs, p):
+    act = p.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.emit("LeakyRelu", ins, outs, node.name,
+                 alpha=float(p.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.emit("Elu", ins, outs, node.name,
+                 alpha=float(p.get("slope", 0.25)))
+    elif act == "selu":
+        ctx.emit("Selu", ins, outs, node.name)
+    elif act == "prelu":
+        ctx.emit("PRelu", ins, outs, node.name)
+    else:
+        raise MXNetError("ONNX export: LeakyReLU %r" % act)
+
+
+@_handler("Pooling")
+def _pool(ctx, node, ins, outs, p):
+    ptype = p.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise MXNetError("ONNX export: pool_type %r" % ptype)
+    if p.get("global_pool"):
+        ctx.emit("GlobalMaxPool" if ptype == "max"
+                 else "GlobalAveragePool", ins, outs, node.name)
+        return
+    k = _ints(p.get("kernel", ()))
+    attrs = {"kernel_shape": k,
+             "strides": _ints(p.get("stride") or [1] * len(k)),
+             "pads": _ints(p.get("pad") or [0] * len(k)) * 2}
+    if p.get("pooling_convention", "valid") == "full":
+        attrs["ceil_mode"] = 1
+    if ptype == "avg":
+        attrs["count_include_pad"] = 1  # the mxnet average includes pad
+    ctx.emit("MaxPool" if ptype == "max" else "AveragePool",
+             ins, outs, node.name, **attrs)
+
+
+@_handler("BatchNorm")
+def _bn(ctx, node, ins, outs, p):
+    if p.get("fix_gamma", True):
+        ctx.force_ones.add(ins[1])
+    ctx.emit("BatchNormalization", ins, outs, node.name,
+             epsilon=float(p.get("eps", 1e-3)),
+             momentum=float(p.get("momentum", 0.9)))
+
+
+@_handler("InstanceNorm")
+def _in(ctx, node, ins, outs, p):
+    ctx.emit("InstanceNormalization", ins, outs, node.name,
+             epsilon=float(p.get("eps", 1e-3)))
+
+
+@_handler("LRN")
+def _lrn(ctx, node, ins, outs, p):
+    ctx.emit("LRN", ins, outs, node.name,
+             size=int(p["nsize"]), alpha=float(p.get("alpha", 1e-4)),
+             beta=float(p.get("beta", 0.75)),
+             bias=float(p.get("knorm", 2.0)))
+
+
+@_handler("L2Normalization")
+def _l2norm(ctx, node, ins, outs, p):
+    if p.get("mode", "instance") != "channel":
+        raise MXNetError("ONNX export: L2Normalization mode must be "
+                         "'channel' (LpNormalization is single-axis)")
+    ctx.emit("LpNormalization", ins, outs, node.name, p=2, axis=1)
+
+
+@_handler("Dropout")
+def _dropout(ctx, node, ins, outs, p):
+    ratio = ctx.const(node.name + "_ratio",
+                      np.float32(p.get("p", 0.5)))
+    ctx.emit("Dropout", ins + [ratio], outs, node.name)
+
+
+@_handler("softmax", "SoftmaxActivation")
+def _softmax(ctx, node, ins, outs, p):
+    ctx.emit("Softmax", ins, outs, node.name,
+             axis=int(p.get("axis", -1)))
+
+
+@_handler("SoftmaxOutput")
+def _softmax_out(ctx, node, ins, outs, p):
+    ctx.emit("Softmax", ins, outs, node.name, axis=1)
+
+
+@_handler("log_softmax")
+def _log_softmax(ctx, node, ins, outs, p):
+    ctx.emit("LogSoftmax", ins, outs, node.name,
+             axis=int(p.get("axis", -1)))
+
+
+@_handler("Reshape")
+def _reshape(ctx, node, ins, outs, p):
+    if p.get("reverse"):
+        raise MXNetError("ONNX export: Reshape(reverse=True)")
+    shp = ctx.const(node.name + "_shape",
+                    np.asarray(p.get("shape", ()), np.int64))
+    ctx.emit("Reshape", ins + [shp], outs, node.name)
+
+
+@_handler("transpose")
+def _transpose(ctx, node, ins, outs, p):
+    axes = p.get("axes")
+    attrs = {"perm": _ints(axes)} if axes else {}
+    ctx.emit("Transpose", ins, outs, node.name, **attrs)
+
+
+@_handler("expand_dims")
+def _expand_dims(ctx, node, ins, outs, p):
+    ax = ctx.const(node.name + "_axes",
+                   np.asarray([p["axis"]], np.int64))
+    ctx.emit("Unsqueeze", ins + [ax], outs, node.name)
+
+
+@_handler("squeeze")
+def _squeeze(ctx, node, ins, outs, p):
+    axis = p.get("axis")
+    if axis is None:
+        ctx.emit("Squeeze", ins, outs, node.name)
+    else:
+        if isinstance(axis, int):
+            axis = [axis]
+        ax = ctx.const(node.name + "_axes", np.asarray(axis, np.int64))
+        ctx.emit("Squeeze", ins + [ax], outs, node.name)
+
+
+@_handler("Concat")
+def _concat(ctx, node, ins, outs, p):
+    ctx.emit("Concat", ins, outs, node.name, axis=int(p.get("dim", 1)))
+
+
+@_handler("SliceChannel")
+def _slice_channel(ctx, node, ins, outs, p):
+    if p.get("squeeze_axis"):
+        raise MXNetError("ONNX export: SliceChannel(squeeze_axis=True)")
+    ctx.emit("Split", ins, outs, node.name, axis=int(p.get("axis", 1)))
+
+
+@_handler("slice")
+def _slice(ctx, node, ins, outs, p):
+    begin = list(p["begin"])
+    end = list(p["end"])
+    step = list(p.get("step") or [1] * len(begin))
+    if any(s is not None and int(s) < 0 for s in step):
+        raise MXNetError("ONNX export: slice with negative step (the "
+                         "None-endpoint mapping differs; reverse + "
+                         "positive-step slice instead)")
+    imax = np.iinfo(np.int64).max
+    starts = [0 if b is None else int(b) for b in begin]
+    ends = [imax if e is None else int(e) for e in end]
+    names = [ctx.const(node.name + s, np.asarray(v, np.int64))
+             for s, v in [("_starts", starts), ("_ends", ends),
+                          ("_axes", list(range(len(begin)))),
+                          ("_steps", _ints(step))]]
+    ctx.emit("Slice", ins + names, outs, node.name)
+
+
+@_handler("slice_axis")
+def _slice_axis(ctx, node, ins, outs, p):
+    imax = np.iinfo(np.int64).max
+    end = p["end"]
+    names = [ctx.const(node.name + s, np.asarray(v, np.int64))
+             for s, v in [("_starts", [int(p["begin"])]),
+                          ("_ends", [imax if end is None else int(end)]),
+                          ("_axes", [int(p["axis"])])]]
+    ctx.emit("Slice", ins + names, outs, node.name)
+
+
+@_handler("clip")
+def _clip(ctx, node, ins, outs, p):
+    lo = ctx.const(node.name + "_min", np.float32(p["a_min"]))
+    hi = ctx.const(node.name + "_max", np.float32(p["a_max"]))
+    ctx.emit("Clip", ins + [lo, hi], outs, node.name)
+
+
+@_handler("Pad")
+def _pad(ctx, node, ins, outs, p):
+    pw = list(p.get("pad_width", ()))
+    begins, ends = pw[0::2], pw[1::2]
+    pads = ctx.const(node.name + "_pads",
+                     np.asarray(begins + ends, np.int64))
+    mode = p.get("mode", "constant")
+    cval = ctx.const(node.name + "_cval",
+                     np.float32(p.get("constant_value", 0)))
+    ctx.emit("Pad", ins + [pads, cval], outs, node.name, mode=mode)
+
+
+@_handler("Cast")
+def _cast(ctx, node, ins, outs, p):
+    ctx.emit("Cast", ins, outs, node.name,
+             to=int(P.NP2ONNX[np.dtype(p["dtype"])]))
+
+
+@_handler("tile")
+def _tile(ctx, node, ins, outs, p):
+    reps = ctx.const(node.name + "_reps",
+                     np.asarray(p["reps"], np.int64))
+    ctx.emit("Tile", ins + [reps], outs, node.name)
+
+
+@_handler("broadcast_to")
+def _broadcast_to(ctx, node, ins, outs, p):
+    shp = ctx.const(node.name + "_shape",
+                    np.asarray(p["shape"], np.int64))
+    ctx.emit("Expand", ins + [shp], outs, node.name)
+
+
+@_handler("where")
+def _where(ctx, node, ins, outs, p):
+    cond = node.name + "_cond"
+    ctx.emit("Cast", [ins[0]], [cond], to=int(P.BOOL))
+    ctx.emit("Where", [cond] + ins[1:], outs, node.name)
+
+
+@_handler("Embedding")
+def _embedding(ctx, node, ins, outs, p):
+    idx = node.name + "_idx"
+    ctx.emit("Cast", [ins[0]], [idx], to=int(P.INT64))
+    ctx.emit("Gather", [ins[1], idx], outs, node.name, axis=0)
+
+
+@_handler("take")
+def _take(ctx, node, ins, outs, p):
+    idx = node.name + "_idx"
+    ctx.emit("Cast", [ins[1]], [idx], to=int(P.INT64))
+    ctx.emit("Gather", [ins[0], idx], outs, node.name,
+             axis=int(p.get("axis", 0)))
+
+
+@_handler("sum")
+def _reduce_sum(ctx, node, ins, outs, p):
+    if p.get("exclude"):
+        raise MXNetError("ONNX export: sum(exclude=True)")
+    attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
+    axis = p.get("axis")
+    extra = []
+    if axis is not None:
+        if isinstance(axis, int):
+            axis = [axis]
+        extra = [ctx.const(node.name + "_axes",
+                           np.asarray(axis, np.int64))]
+    ctx.emit("ReduceSum", ins + extra, outs, node.name, **attrs)
+
+
+def _reduce_attr(onnx_type):
+    def h(ctx, node, ins, outs, p):
+        if p.get("exclude"):
+            raise MXNetError("ONNX export: reduce(exclude=True)")
+        attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
+        axis = p.get("axis")
+        if axis is not None:
+            attrs["axes"] = [axis] if isinstance(axis, int) \
+                else _ints(axis)
+        ctx.emit(onnx_type, ins, outs, node.name, **attrs)
+    return h
+
+
+for _mx, _ox in _REDUCE_ATTR.items():
+    HANDLERS[_mx] = _reduce_attr(_ox)
+
+
+@_handler("argmax", "argmin")
+def _argmax(ctx, node, ins, outs, p):
+    if p.get("axis") is None:
+        raise MXNetError("ONNX export: argmax needs an explicit axis")
+    out_i = node.name + "_i64"
+    ctx.emit("ArgMax" if node.op.name == "argmax" else "ArgMin",
+             ins, [out_i], axis=int(p["axis"]),
+             keepdims=int(bool(p.get("keepdims", False))))
+    ctx.emit("Cast", [out_i], outs, node.name, to=int(P.FLOAT))
+
+
+@_handler("UpSampling")
+def _upsampling(ctx, node, ins, outs, p):
+    if p.get("sample_type", "nearest") != "nearest":
+        raise MXNetError("ONNX export: UpSampling bilinear")
+    s = float(p["scale"])
+    scales = ctx.const(node.name + "_scales",
+                       np.asarray([1.0, 1.0, s, s], np.float32))
+    ctx.emit("Resize", [ins[0], "", scales], outs, node.name,
+             mode="nearest", nearest_mode="floor",
+             coordinate_transformation_mode="asymmetric")
+
+
+@_handler("add_n", "ElementWiseSum")
+def _add_n(ctx, node, ins, outs, p):
+    ctx.emit("Sum", ins, outs, node.name)
+
+
+def _scalar_handler(onnx_type, scalar_first):
+    def h(ctx, node, ins, outs, p):
+        c = ctx.const(node.name + "_const",
+                      np.float32(p.get("scalar", 0.0)))
+        pair = [c, ins[0]] if scalar_first else [ins[0], c]
+        ctx.emit(onnx_type, pair, outs, node.name)
+    return h
+
+
+for _mx, (_ox, _first) in _SCALAR_OPS.items():
+    HANDLERS[_mx] = _scalar_handler(_ox, _first)
+
+
+def _simple_unary(onnx_type):
+    def h(ctx, node, ins, outs, p):
+        attrs = {"axis": 1} if onnx_type == "Flatten" else {}
+        ctx.emit(onnx_type, ins[:1], outs, node.name, **attrs)
+    return h
+
+
+for _mx, _ox in _SIMPLE_UNARY.items():
+    HANDLERS.setdefault(_mx, _simple_unary(_ox))
+
+
+def _simple_binary(onnx_type):
+    def h(ctx, node, ins, outs, p):
+        if p.get("transpose_a") or p.get("transpose_b"):
+            raise MXNetError("ONNX export: dot with transpose")
+        ctx.emit(onnx_type, ins, outs, node.name)
+    return h
+
+
+for _mx, _ox in _SIMPLE_BINARY.items():
+    HANDLERS.setdefault(_mx, _simple_binary(_ox))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
 def export_model(sym, params, input_shape, input_type=np.float32,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Exports a symbol + params to an ONNX file
-    (reference: export_model.py:32). Requires the `onnx` package."""
-    try:
-        import onnx
-        from onnx import helper, TensorProto, numpy_helper
-    except ImportError as e:
-        raise ImportError(
-            "export_model requires the `onnx` package, which is not "
-            "installed in this environment.") from e
-
+                 onnx_file_path="model.onnx", verbose=False, opset=13):
+    """Export a symbol + params to an ONNX file (reference:
+    export_model.py:32). Self-contained — no `onnx` package needed."""
     if isinstance(sym, str):
         sym = sym_mod.load(sym)
     if isinstance(params, str):
@@ -72,13 +504,14 @@ def export_model(sym, params, input_shape, input_type=np.float32,
         params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
     if not isinstance(sym, Symbol):
         raise MXNetError("sym must be a Symbol or path to symbol json")
-
     if isinstance(input_shape, tuple):
         input_shape = [input_shape]
-    # label inputs of *Output heads are dropped from the exported graph
+
+    from ...graph import topo_order
+    order = topo_order(sym._entries)
+
     label_names = set()
-    from ...graph import topo_order as _topo
-    for node in _topo(sym._entries):
+    for node in order:
         if not node.is_variable and node.op.name in _DROP_LABEL_INPUT \
                 and len(node.inputs) > 1:
             lab = node.inputs[-1][0]
@@ -86,80 +519,52 @@ def export_model(sym, params, input_shape, input_type=np.float32,
                 label_names.add(lab.name)
     inputs = [n for n in sym.list_inputs()
               if n not in params and n not in label_names]
-    assert len(inputs) == len(input_shape), \
-        "need one input_shape per data input %s" % inputs
+    if len(inputs) != len(input_shape):
+        raise MXNetError("need one input_shape per data input %s"
+                         % inputs)
 
-    nodes = []
-    initializers = []
-    value_name = {}
+    ctx = _Ctx()
 
     def name_of(node, idx):
         return "%s_out%d" % (node.name, idx) if idx else node.name
 
-    for pname, arr in params.items():
-        initializers.append(numpy_helper.from_array(
-            arr.asnumpy(), name=pname))
-
-    from ...graph import topo_order
-    order = topo_order(sym._entries)
     for node in order:
         if node.is_variable:
             continue
         op_name = node.op.name
-        if op_name not in _MX2ONNX:
-            raise MXNetError(
-                "ONNX export: unsupported op %s" % op_name)
-        onnx_type, translate = _MX2ONNX[op_name]
+        if op_name not in HANDLERS:
+            raise MXNetError("ONNX export: unsupported op %s (of %d "
+                             "handled)" % (op_name, len(HANDLERS)))
         node_inputs = node.inputs
         if op_name in _DROP_LABEL_INPUT and len(node_inputs) > 1:
             node_inputs = node_inputs[:1]
         in_names = [name_of(i, idx) for (i, idx) in node_inputs]
-        if onnx_type == "__reshape__":
-            # ONNX Reshape takes the target shape as an int64 input
-            shape_name = node.name + "_shape"
-            initializers.append(numpy_helper.from_array(
-                np.asarray(node.params.get("shape", ()),
-                           dtype=np.int64), name=shape_name))
-            nodes.append(helper.make_node(
-                "Reshape", in_names + [shape_name],
-                [name_of(node, 0)], name=node.name))
-            value_name[id(node)] = name_of(node, 0)
-            continue
-        if onnx_type == "__act__":
-            onnx_type = _ACT2ONNX.get(
-                node.params.get("act_type", "relu"), "Relu")
-            attrs = {}
-        elif onnx_type == "__pool__":
-            ptype = node.params.get("pool_type", "max")
-            if node.params.get("global_pool"):
-                onnx_type = "GlobalMaxPool" if ptype == "max" \
-                    else "GlobalAveragePool"
-                attrs = {}
-            else:
-                onnx_type = "MaxPool" if ptype == "max" \
-                    else "AveragePool"
-                k = list(node.params.get("kernel", ()))
-                attrs = {"kernel_shape": k,
-                         "strides": list(node.params.get("stride") or
-                                         [1] * len(k)),
-                         "pads": list(node.params.get("pad") or
-                                      [0] * len(k)) * 2}
-        else:
-            attrs = translate(node.params)
-        nodes.append(helper.make_node(
-            onnx_type, in_names, [name_of(node, 0)], name=node.name,
-            **attrs))
-        value_name[id(node)] = name_of(node, 0)
+        n_out = node.op.out_arity(node.params) \
+            if hasattr(node.op, "out_arity") else 1
+        vis = node.op.visible_outputs
+        if callable(vis):
+            n_out = vis(node.params)
+        elif vis:
+            n_out = vis
+        out_names = [name_of(node, i) for i in range(n_out)]
+        HANDLERS[op_name](ctx, node, in_names, out_names, node.params)
 
-    onnx_dtype = TensorProto.FLOAT
-    graph_inputs = [
-        helper.make_tensor_value_info(n, onnx_dtype, list(s))
-        for n, s in zip(inputs, input_shape)]
-    graph_outputs = [
-        helper.make_tensor_value_info(name_of(n, i), onnx_dtype, None)
-        for (n, i) in sym._entries]
-    graph = helper.make_graph(nodes, "mxnet_tpu_model", graph_inputs,
-                              graph_outputs, initializer=initializers)
-    model = helper.make_model(graph)
-    onnx.save(model, onnx_file_path)
+    for pname, arr in params.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        if pname in ctx.force_ones:
+            a = np.ones_like(a)
+        ctx.initializers.append(P.Tensor(pname, a))
+
+    g = P.Graph("mxnet_tpu_model")
+    g.nodes = ctx.nodes
+    g.initializers.extend(ctx.initializers)
+    onnx_dtype = P.NP2ONNX[np.dtype(input_type)]
+    g.inputs = [P.ValueInfo(n, onnx_dtype, list(s))
+                for n, s in zip(inputs, input_shape)]
+    g.outputs = [P.ValueInfo(name_of(n, i), onnx_dtype, None)
+                 for (n, i) in sym._entries]
+    P.save(P.Model(g, opset=opset), onnx_file_path)
+    if verbose:
+        print("exported %d nodes / %d initializers -> %s"
+              % (len(g.nodes), len(g.initializers), onnx_file_path))
     return onnx_file_path

@@ -1,6 +1,11 @@
 """ONNX -> Symbol import.
 
-Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py.
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py plus the
+translators in onnx2mx/_op_translations.py (603 LoC). Parses through
+the self-contained codec in `_proto.py` (no `onnx` package), accepts
+graphs from any producer (typed data fields, unpacked repeated
+scalars, Gemm with alpha/beta folding), and inverts everything
+mx2onnx.py emits.
 """
 from __future__ import annotations
 
@@ -9,130 +14,539 @@ import numpy as np
 from ...base import MXNetError
 from ... import symbol as sym_mod
 from ... import ndarray
+from . import _proto as P
 
 __all__ = ["import_model"]
 
 
-def _attr_dict(onnx_node):
-    from onnx import helper
-    return {a.name: helper.get_attribute_value(a)
-            for a in onnx_node.attribute}
+IMPORTERS = {}
+
+
+def _imp(*names):
+    def deco(fn):
+        for n in names:
+            IMPORTERS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """State of one import: tensor-name -> Symbol, plus constants."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.tensors = {}
+        self.arg_params = {}
+        self.consumed = set()  # initializers folded into attrs
+
+    def sym(self, name):
+        if name not in self.tensors:
+            raise MXNetError("ONNX import: unknown tensor %r" % name)
+        return self.tensors[name]
+
+    def const(self, name):
+        """An input that must be a compile-time constant (shape, axes,
+        pads...). Folds the initializer instead of making a variable."""
+        if name not in self.arg_params:
+            raise MXNetError(
+                "ONNX import: input %r must be an initializer" % name)
+        self.consumed.add(name)
+        return self.arg_params[name].asnumpy()
+
+    def maybe_const(self, name):
+        return (self.arg_params[name].asnumpy()
+                if name in self.arg_params else None)
+
+
+def _pads2mx(attrs, nd_):
+    pads = [int(x) for x in attrs.get("pads", [0] * (2 * nd_))]
+    begin, end = pads[:nd_], pads[nd_:]
+    if begin != end:
+        raise MXNetError("ONNX import: asymmetric pads %s" % pads)
+    return tuple(begin)
+
+
+@_imp("Conv")
+def _conv(ctx, node, ins, attrs):
+    k = tuple(int(x) for x in attrs["kernel_shape"])
+    w = ctx.arg_params[node.inputs[1]]
+    return sym_mod.Convolution(
+        *ins, kernel=k, num_filter=int(w.shape[0]),
+        stride=tuple(attrs.get("strides", (1,) * len(k))),
+        pad=_pads2mx(attrs, len(k)),
+        dilate=tuple(attrs.get("dilations", (1,) * len(k))),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(ins) < 3)
+
+
+@_imp("ConvTranspose")
+def _deconv(ctx, node, ins, attrs):
+    k = tuple(int(x) for x in attrs["kernel_shape"])
+    w = ctx.arg_params[node.inputs[1]]
+    kw = {}
+    if attrs.get("output_padding"):
+        kw["adj"] = tuple(attrs["output_padding"])
+    return sym_mod.Deconvolution(
+        *ins, kernel=k, num_filter=int(w.shape[1]) *
+        int(attrs.get("group", 1)),
+        stride=tuple(attrs.get("strides", (1,) * len(k))),
+        pad=_pads2mx(attrs, len(k)),
+        dilate=tuple(attrs.get("dilations", (1,) * len(k))),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(ins) < 3, **kw)
+
+
+@_imp("Gemm")
+def _gemm(ctx, node, ins, attrs):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if int(attrs.get("transA", 0)):
+        raise MXNetError("ONNX import: Gemm(transA=1)")
+    wname = node.inputs[1]
+    if wname not in ctx.arg_params:
+        raise MXNetError("ONNX import: Gemm weight must be an "
+                         "initializer")
+    w = ctx.arg_params[wname].asnumpy()
+    if not int(attrs.get("transB", 0)):
+        w = w.T  # FullyConnected stores (out, in)
+    if alpha != 1.0:
+        w = alpha * w  # fold alpha into the weight
+    ctx.arg_params[wname] = ndarray.array(np.ascontiguousarray(w))
+    if len(ins) > 2 and beta != 1.0:
+        bname = node.inputs[2]
+        b = ctx.arg_params[bname].asnumpy()
+        ctx.arg_params[bname] = ndarray.array(beta * b)
+    return sym_mod.FullyConnected(
+        ins[0], ins[1], *ins[2:3], num_hidden=int(w.shape[0]),
+        no_bias=len(ins) < 3, flatten=False)
+
+
+@_imp("MatMul")
+def _matmul(ctx, node, ins, attrs):
+    return sym_mod.dot(ins[0], ins[1])
+
+
+@_imp("BatchNormalization")
+def _bn(ctx, node, ins, attrs):
+    return sym_mod.BatchNorm(
+        *ins, eps=float(attrs.get("epsilon", 1e-5)),
+        momentum=float(attrs.get("momentum", 0.9)), fix_gamma=False)
+
+
+@_imp("InstanceNormalization")
+def _in(ctx, node, ins, attrs):
+    return sym_mod.InstanceNorm(
+        *ins, eps=float(attrs.get("epsilon", 1e-5)))
+
+
+@_imp("LRN")
+def _lrn(ctx, node, ins, attrs):
+    return sym_mod.LRN(ins[0], nsize=int(attrs["size"]),
+                       alpha=float(attrs.get("alpha", 1e-4)),
+                       beta=float(attrs.get("beta", 0.75)),
+                       knorm=float(attrs.get("bias", 1.0)))
+
+
+@_imp("LpNormalization")
+def _lpnorm(ctx, node, ins, attrs):
+    if int(attrs.get("p", 2)) != 2 or int(attrs.get("axis", -1)) != 1:
+        raise MXNetError("ONNX import: LpNormalization only p=2 axis=1")
+    return sym_mod.L2Normalization(ins[0], mode="channel")
+
+
+_ACTS = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+         "Softplus": "softrelu", "Softsign": "softsign"}
+
+
+for _ox, _mx in _ACTS.items():
+    IMPORTERS[_ox] = (lambda act: lambda ctx, node, ins, attrs:
+                      sym_mod.Activation(ins[0], act_type=act))(_mx)
+
+
+_UNARY = {"Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
+          "Neg": "negative", "Floor": "floor", "Ceil": "ceil",
+          "Erf": "erf", "Round": "round", "Sign": "sign",
+          "Reciprocal": "reciprocal", "Identity": "_copy",
+          "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "arcsin",
+          "Acos": "arccos", "Atan": "arctan"}
+
+for _ox, _mx in _UNARY.items():
+    IMPORTERS[_ox] = (lambda opn: lambda ctx, node, ins, attrs:
+                      getattr(sym_mod, opn)(ins[0]))(_mx)
+
+
+_BINARY = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+           "Mul": "broadcast_mul", "Div": "broadcast_div",
+           "Pow": "broadcast_power"}
+
+for _ox, _mx in _BINARY.items():
+    IMPORTERS[_ox] = (lambda opn: lambda ctx, node, ins, attrs:
+                      getattr(sym_mod, opn)(ins[0], ins[1]))(_mx)
+
+
+@_imp("Max")
+def _vmax(ctx, node, ins, attrs):
+    out = ins[0]
+    for x in ins[1:]:
+        out = sym_mod.broadcast_maximum(out, x)
+    return out
+
+
+@_imp("Min")
+def _vmin(ctx, node, ins, attrs):
+    out = ins[0]
+    for x in ins[1:]:
+        out = sym_mod.broadcast_minimum(out, x)
+    return out
+
+
+@_imp("Sum")
+def _vsum(ctx, node, ins, attrs):
+    return sym_mod.add_n(*ins, num_args=len(ins))
+
+
+@_imp("MaxPool", "AveragePool")
+def _pool(ctx, node, ins, attrs):
+    k = tuple(int(x) for x in attrs["kernel_shape"])
+    pad = _pads2mx(attrs, len(k))
+    if (node.op_type == "AveragePool" and any(pad)
+            and not attrs.get("count_include_pad")):
+        # mx Pooling's average always counts padding; importing this
+        # silently would under-scale every border window
+        raise MXNetError("ONNX import: AveragePool with pads and "
+                         "count_include_pad=0 has no mx equivalent")
+    return sym_mod.Pooling(
+        ins[0], kernel=k,
+        pool_type="max" if node.op_type == "MaxPool" else "avg",
+        stride=tuple(attrs.get("strides", (1,) * len(k))),
+        pad=pad,
+        pooling_convention="full" if attrs.get("ceil_mode") else "valid")
+
+
+@_imp("GlobalMaxPool", "GlobalAveragePool")
+def _gpool(ctx, node, ins, attrs):
+    return sym_mod.Pooling(
+        ins[0], global_pool=True, kernel=(1, 1),
+        pool_type="max" if node.op_type == "GlobalMaxPool" else "avg")
+
+
+@_imp("Flatten")
+def _flatten(ctx, node, ins, attrs):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError("ONNX import: Flatten axis != 1")
+    return sym_mod.Flatten(ins[0])
+
+
+@_imp("Reshape")
+def _reshape(ctx, node, ins, attrs):
+    if len(node.inputs) > 1:
+        shape = tuple(int(x) for x in ctx.const(node.inputs[1]))
+    else:
+        shape = tuple(int(x) for x in attrs.get("shape", ()))
+    return sym_mod.Reshape(ins[0], shape=shape)
+
+
+@_imp("Transpose")
+def _transpose(ctx, node, ins, attrs):
+    perm = attrs.get("perm")
+    return sym_mod.transpose(
+        ins[0], axes=tuple(int(x) for x in perm) if perm else None)
+
+
+@_imp("Concat")
+def _concat(ctx, node, ins, attrs):
+    return sym_mod.Concat(*ins, dim=int(attrs.get("axis", 1)))
+
+
+@_imp("Split")
+def _split(ctx, node, ins, attrs):
+    if len(node.inputs) > 1:
+        sizes = [int(x) for x in ctx.const(node.inputs[1])]
+        if len(set(sizes)) != 1:
+            raise MXNetError("ONNX import: non-uniform Split")
+    return sym_mod.SliceChannel(
+        ins[0], num_outputs=len(node.outputs),
+        axis=int(attrs.get("axis", 0)))
+
+
+@_imp("Squeeze")
+def _squeeze(ctx, node, ins, attrs):
+    if len(node.inputs) > 1:
+        axes = tuple(int(x) for x in ctx.const(node.inputs[1]))
+    else:
+        axes = tuple(int(x) for x in attrs.get("axes", ())) or None
+    return sym_mod.squeeze(ins[0], axis=axes)
+
+
+@_imp("Unsqueeze")
+def _unsqueeze(ctx, node, ins, attrs):
+    if len(node.inputs) > 1:
+        axes = [int(x) for x in ctx.const(node.inputs[1])]
+    else:
+        axes = [int(x) for x in attrs.get("axes", ())]
+    out = ins[0]
+    for ax in sorted(axes):
+        out = sym_mod.expand_dims(out, axis=ax)
+    return out
+
+
+@_imp("Slice")
+def _slice(ctx, node, ins, attrs):
+    if len(node.inputs) >= 3:
+        starts = [int(x) for x in ctx.const(node.inputs[1])]
+        ends = [int(x) for x in ctx.const(node.inputs[2])]
+        axes = ([int(x) for x in ctx.const(node.inputs[3])]
+                if len(node.inputs) > 3 else list(range(len(starts))))
+        steps = ([int(x) for x in ctx.const(node.inputs[4])]
+                 if len(node.inputs) > 4 else [1] * len(starts))
+    else:  # opset <10 attribute form
+        starts = [int(x) for x in attrs["starts"]]
+        ends = [int(x) for x in attrs["ends"]]
+        axes = [int(x) for x in
+                attrs.get("axes", range(len(starts)))]
+        steps = [1] * len(starts)
+    imax = np.iinfo(np.int64).max
+    out = ins[0]
+    for ax, b, e, st in zip(axes, starts, ends, steps):
+        out = sym_mod.slice_axis(
+            out, axis=ax, begin=b,
+            end=None if e >= imax // 2 else e)
+        if st != 1:
+            raise MXNetError("ONNX import: Slice step != 1")
+    return out
+
+
+def _scalar(x):
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+@_imp("Clip")
+def _clip(ctx, node, ins, attrs):
+    lo, hi = -np.inf, np.inf
+    if len(node.inputs) > 1:  # opset 11+: optional min/max inputs
+        if len(node.inputs) > 1 and node.inputs[1]:
+            lo = _scalar(ctx.const(node.inputs[1]))
+        if len(node.inputs) > 2 and node.inputs[2]:
+            hi = _scalar(ctx.const(node.inputs[2]))
+    else:
+        lo = float(attrs.get("min", -np.inf))
+        hi = float(attrs.get("max", np.inf))
+    return sym_mod.clip(ins[0], a_min=lo, a_max=hi)
+
+
+@_imp("Pad")
+def _pad(ctx, node, ins, attrs):
+    if len(node.inputs) > 1:
+        pads = [int(x) for x in ctx.const(node.inputs[1])]
+        cval = (_scalar(ctx.const(node.inputs[2]))
+                if len(node.inputs) > 2 and node.inputs[2] else 0.0)
+    else:
+        pads = [int(x) for x in attrs["pads"]]
+        cval = float(attrs.get("value", 0.0))
+    nd_ = len(pads) // 2
+    pw = []
+    for i in range(nd_):
+        pw += [pads[i], pads[nd_ + i]]
+    return sym_mod.Pad(ins[0], mode=attrs.get("mode", "constant"),
+                       pad_width=tuple(pw), constant_value=cval)
+
+
+@_imp("Cast")
+def _cast(ctx, node, ins, attrs):
+    np_dt = P.ONNX2NP.get(int(attrs["to"]))
+    if np_dt is None:
+        raise MXNetError("ONNX import: Cast to %r" % attrs["to"])
+    return sym_mod.Cast(ins[0], dtype=str(np_dt))
+
+
+@_imp("Tile")
+def _tile(ctx, node, ins, attrs):
+    reps = tuple(int(x) for x in ctx.const(node.inputs[1]))
+    return sym_mod.tile(ins[0], reps=reps)
+
+
+@_imp("Expand")
+def _expand(ctx, node, ins, attrs):
+    shape = tuple(int(x) for x in ctx.const(node.inputs[1]))
+    return sym_mod.broadcast_to(ins[0], shape=shape)
+
+
+@_imp("Where")
+def _where(ctx, node, ins, attrs):
+    return sym_mod.where(ins[0], ins[1], ins[2])
+
+
+@_imp("Gather")
+def _gather(ctx, node, ins, attrs):
+    return sym_mod.take(ins[0], ins[1],
+                        axis=int(attrs.get("axis", 0)))
+
+
+@_imp("Dropout")
+def _dropout(ctx, node, ins, attrs):
+    p = 0.5
+    if len(node.inputs) > 1 and node.inputs[1]:
+        c = ctx.maybe_const(node.inputs[1])
+        if c is not None:
+            ctx.consumed.add(node.inputs[1])
+            p = float(np.asarray(c).reshape(-1)[0])
+    elif "ratio" in attrs:
+        p = float(attrs["ratio"])
+    return sym_mod.Dropout(ins[0], p=p)
+
+
+@_imp("Softmax")
+def _softmax(ctx, node, ins, attrs):
+    return sym_mod.softmax(ins[0], axis=int(attrs.get("axis", -1)))
+
+
+@_imp("LogSoftmax")
+def _log_softmax(ctx, node, ins, attrs):
+    return sym_mod.log_softmax(ins[0], axis=int(attrs.get("axis", -1)))
+
+
+@_imp("LeakyRelu")
+def _leaky(ctx, node, ins, attrs):
+    return sym_mod.LeakyReLU(ins[0], act_type="leaky",
+                             slope=float(attrs.get("alpha", 0.01)))
+
+
+@_imp("Elu")
+def _elu(ctx, node, ins, attrs):
+    return sym_mod.LeakyReLU(ins[0], act_type="elu",
+                             slope=float(attrs.get("alpha", 1.0)))
+
+
+@_imp("Selu")
+def _selu(ctx, node, ins, attrs):
+    return sym_mod.LeakyReLU(ins[0], act_type="selu")
+
+
+@_imp("PRelu")
+def _prelu(ctx, node, ins, attrs):
+    return sym_mod.LeakyReLU(ins[0], ins[1], act_type="prelu")
+
+
+@_imp("ReduceSum")
+def _reduce_sum(ctx, node, ins, attrs):
+    if len(node.inputs) > 1 and node.inputs[1]:
+        axes = tuple(int(x) for x in ctx.const(node.inputs[1]))
+    else:
+        axes = tuple(int(x) for x in attrs.get("axes", ())) or None
+    return sym_mod.sum(ins[0], axis=axes,
+                       keepdims=bool(attrs.get("keepdims", 1)))
+
+
+_REDUCE = {"ReduceMean": "mean", "ReduceMax": "max",
+           "ReduceMin": "min", "ReduceProd": "prod"}
+
+
+def _reduce_attr(mx_name):
+    def h(ctx, node, ins, attrs):
+        axes = tuple(int(x) for x in attrs.get("axes", ())) or None
+        return getattr(sym_mod, mx_name)(
+            ins[0], axis=axes, keepdims=bool(attrs.get("keepdims", 1)))
+    return h
+
+
+for _ox, _mx in _REDUCE.items():
+    IMPORTERS[_ox] = _reduce_attr(_mx)
+
+
+@_imp("ArgMax", "ArgMin")
+def _argmax(ctx, node, ins, attrs):
+    fn = sym_mod.argmax if node.op_type == "ArgMax" else sym_mod.argmin
+    return fn(ins[0], axis=int(attrs.get("axis", 0)),
+              keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@_imp("Resize", "Upsample")
+def _resize(ctx, node, ins, attrs):
+    mode = attrs.get("mode", "nearest")
+    if mode != "nearest":
+        raise MXNetError("ONNX import: Resize mode %r" % mode)
+    scales = None
+    for i in (2, 1):  # Resize: scales at 2; legacy Upsample: at 1
+        if len(node.inputs) > i and node.inputs[i]:
+            scales = ctx.const(node.inputs[i])
+            break
+    if scales is None:
+        scales = attrs.get("scales")
+    s = int(round(float(np.asarray(scales).reshape(-1)[-1])))
+    return sym_mod.UpSampling(ins[0], scale=s, sample_type="nearest")
+
+
+@_imp("Constant")
+def _constant(ctx, node, ins, attrs):
+    t = attrs.get("value")
+    if not isinstance(t, P.Tensor):
+        raise MXNetError("ONNX import: Constant without tensor value")
+    name = node.outputs[0]
+    ctx.arg_params[name] = ndarray.array(t.array)
+    return sym_mod.var(name)
+
+
+# inputs that are compile-time constants (consumed by ctx.const, never
+# turned into graph variables): op_type -> input slots
+_CONST_SLOTS = {
+    "Reshape": (1,), "Tile": (1,), "Expand": (1,), "Slice": (1, 2, 3, 4),
+    "Squeeze": (1,), "Unsqueeze": (1,), "Clip": (1, 2), "Pad": (1, 2),
+    "Split": (1,), "Resize": (1, 2, 3), "Upsample": (1,),
+    "ReduceSum": (1,), "Dropout": (1,),
+}
 
 
 def import_model(model_file):
-    """Imports an ONNX model file into (sym, arg_params, aux_params)
-    (reference: import_model.py:21). Requires the `onnx` package."""
-    try:
-        import onnx
-        from onnx import numpy_helper
-    except ImportError as e:
-        raise ImportError(
-            "import_model requires the `onnx` package, which is not "
-            "installed in this environment.") from e
-
-    model = onnx.load(model_file)
+    """Import an ONNX file into (sym, arg_params, aux_params)
+    (reference: import_model.py:21). Self-contained parser."""
+    model = P.load(model_file)
     graph = model.graph
+    ctx = _Ctx(graph)
 
-    arg_params = {}
-    for init in graph.initializer:
-        arg_params[init.name] = ndarray.array(
-            numpy_helper.to_array(init))
+    for init in graph.initializers:
+        ctx.arg_params[init.name] = ndarray.array(init.array)
 
-    tensors = {}
-    for inp in graph.input:
-        tensors[inp.name] = sym_mod.var(inp.name)
-    # since ONNX IR 4 initializers need not appear in graph.input
-    for name in arg_params:
-        if name not in tensors:
-            tensors[name] = sym_mod.var(name)
+    for inp in graph.inputs:
+        ctx.tensors[inp.name] = sym_mod.var(inp.name)
 
-    def get(name):
-        if name not in tensors:
-            raise MXNetError("ONNX import: unknown tensor %r" % name)
-        return tensors[name]
-
-    for node in graph.node:
-        attrs = _attr_dict(node)
-        ins = [get(n) for n in node.input]
+    for node in graph.node if hasattr(graph, "node") else graph.nodes:
         t = node.op_type
-        if t == "Gemm":
-            w = arg_params[node.input[1]]
-            trans_b = int(attrs.get("transB", 0))
-            if float(attrs.get("alpha", 1.0)) != 1.0 or \
-                    float(attrs.get("beta", 1.0)) != 1.0:
-                raise MXNetError(
-                    "ONNX import: Gemm with alpha/beta != 1 is not "
-                    "supported")
-            if not trans_b:
-                # FullyConnected expects (out, in); transpose the stored
-                # weight once at import time
-                arg_params[node.input[1]] = ndarray.array(
-                    w.asnumpy().T)
-                w = arg_params[node.input[1]]
-            out = sym_mod.FullyConnected(
-                ins[0], ins[1], *ins[2:3],
-                num_hidden=int(w.shape[0]),
-                no_bias=len(ins) < 3)
-        elif t == "Conv":
-            k = tuple(attrs["kernel_shape"])
-            pads = tuple(attrs.get("pads", (0,) * (2 * len(k))))
-            out = sym_mod.Convolution(
-                *ins, kernel=k,
-                num_filter=int(arg_params[node.input[1]].shape[0]),
-                stride=tuple(attrs.get("strides", (1,) * len(k))),
-                pad=pads[:len(k)],
-                dilate=tuple(attrs.get("dilations", (1,) * len(k))),
-                num_group=int(attrs.get("group", 1)),
-                no_bias=len(ins) < 3)
-        elif t in ("Relu", "Sigmoid", "Tanh", "Softplus"):
-            act = {"Relu": "relu", "Sigmoid": "sigmoid",
-                   "Tanh": "tanh", "Softplus": "softrelu"}[t]
-            out = sym_mod.Activation(ins[0], act_type=act)
-        elif t in ("MaxPool", "AveragePool"):
-            k = tuple(attrs["kernel_shape"])
-            pads = tuple(attrs.get("pads", (0,) * (2 * len(k))))
-            out = sym_mod.Pooling(
-                ins[0], kernel=k,
-                pool_type="max" if t == "MaxPool" else "avg",
-                stride=tuple(attrs.get("strides", (1,) * len(k))),
-                pad=pads[:len(k)])
-        elif t in ("GlobalMaxPool", "GlobalAveragePool"):
-            out = sym_mod.Pooling(
-                ins[0], global_pool=True, kernel=(1, 1),
-                pool_type="max" if t == "GlobalMaxPool" else "avg")
-        elif t == "BatchNormalization":
-            out = sym_mod.BatchNorm(
-                *ins, eps=float(attrs.get("epsilon", 1e-5)),
-                momentum=float(attrs.get("momentum", 0.9)),
-                fix_gamma=False)
-        elif t == "Flatten":
-            out = sym_mod.Flatten(ins[0])
-        elif t == "Softmax":
-            out = sym_mod.softmax(ins[0],
-                                  axis=int(attrs.get("axis", -1)))
-        elif t == "Add":
-            out = ins[0] + ins[1]
-        elif t == "Mul":
-            out = ins[0] * ins[1]
-        elif t == "Concat":
-            out = sym_mod.Concat(*ins, dim=int(attrs.get("axis", 1)))
-        elif t == "Dropout":
-            out = sym_mod.Dropout(ins[0],
-                                  p=float(attrs.get("ratio", 0.5)))
-        elif t == "Reshape":
-            out = sym_mod.Reshape(ins[0],
-                                  shape=tuple(attrs.get("shape", ())))
-        elif t == "Transpose":
-            out = sym_mod.transpose(ins[0],
-                                    axes=tuple(attrs.get("perm", ())))
+        if t not in IMPORTERS:
+            raise MXNetError("ONNX import: unsupported op %s (of %d "
+                             "handled)" % (t, len(IMPORTERS)))
+        const_slots = _CONST_SLOTS.get(t, ())
+        ins = []
+        for i, name in enumerate(node.inputs):
+            if i in const_slots or not name:
+                ins.append(None)
+                continue
+            if name not in ctx.tensors:
+                if name in ctx.arg_params:
+                    ctx.tensors[name] = sym_mod.var(name)
+                else:
+                    raise MXNetError(
+                        "ONNX import: unknown tensor %r" % name)
+            ins.append(ctx.tensors[name])
+        ins = [s for s in ins if s is not None]
+        out = IMPORTERS[t](ctx, node, ins, node.attrs)
+        if isinstance(out, list):
+            outs = out
+        elif len(node.outputs) > 1 and len(out.list_outputs()) > 1:
+            # one multi-output Symbol (Split)
+            outs = [out[i] for i in range(len(node.outputs))]
         else:
-            raise MXNetError("ONNX import: unsupported op %s" % t)
-        outs = out if isinstance(out, list) else [out]
-        for name, o in zip(node.output, outs):
-            tensors[name] = o
+            # extra declared outputs (e.g. Dropout's mask) stay
+            # unmapped; import only fails if something consumes them
+            outs = [out]
+        for name, o in zip(node.outputs, outs):
+            ctx.tensors[name] = o
 
-    result = [get(o.name) for o in graph.output]
+    result = [ctx.sym(o.name) for o in graph.outputs]
     sym = result[0] if len(result) == 1 else sym_mod.Group(result)
+
+    used = set(sym.list_inputs())
+    arg_params = {k: v for k, v in ctx.arg_params.items()
+                  if k in used and k not in ctx.consumed}
     aux_names = set(sym.list_auxiliary_states())
     aux_params = {k: v for k, v in arg_params.items() if k in aux_names}
     arg_params = {k: v for k, v in arg_params.items()
