@@ -1,107 +1,142 @@
-"""Training callbacks.
+"""Training callbacks: epoch checkpointing, metric logging, throughput.
 
-Reference: python/mxnet/callback.py (module_checkpoint :27, do_checkpoint
-:55, log_train_metric, Speedometer, ProgressBar, LogValidationMetricsCallback).
+API parity with the reference's callback module
+(python/mxnet/callback.py: module_checkpoint, do_checkpoint,
+log_train_metric, Speedometer, ProgressBar,
+LogValidationMetricsCallback); the internals are organized around two
+small helpers — `_every` for periodic gating and `_RateMeter` for
+throughput windows — rather than the reference's open-coded state.
 """
 from __future__ import annotations
 
 import logging
-import math
 import sys
 import time
 
 
+def _every(period):
+    """True on epochs/batches 1·p, 2·p, ... (1-based)."""
+    p = int(max(1, period))
+    return lambda i: (i + 1) % p == 0
+
+
+def _metric_pairs(param):
+    """(name, value) pairs of the callback param's metric, or []."""
+    metric = getattr(param, "eval_metric", None)
+    return metric.get_name_value() if metric else []
+
+
+class _RateMeter:
+    """Samples/sec across reporting windows of batch callbacks.
+
+    Call observe(count) once per batch: it arms on the first call,
+    re-arms (without reporting) when the batch counter goes backwards
+    — a new epoch — and returns a samples/sec figure exactly when a
+    window boundary is crossed while armed."""
+
+    def __init__(self, batch_size, window):
+        self.batch_size = batch_size
+        self.window = window
+        self._t0 = None
+        self._last = 0
+
+    def observe(self, count):
+        if count < self._last:
+            self._t0 = None  # epoch rollover
+        self._last = count
+        if self._t0 is None:
+            self._t0 = time.time()
+            return None
+        if count % self.window:
+            return None
+        dt = time.time() - self._t0
+        self._t0 = time.time()
+        return self.window * self.batch_size / max(dt, 1e-12)
+
+
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the model per epoch (reference: callback.py:27)."""
-    period = int(max(1, period))
+    """Epoch-end callback saving module state (reference: callback.py:27)."""
+    due = _every(period)
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
+        if due(iter_no):
             mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Checkpoint params every `period` epochs (reference: callback.py:55)."""
-    period = int(max(1, period))
+    """Epoch-end callback saving symbol+params (reference: callback.py:55)."""
+    due = _every(period)
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
+        if due(iter_no):
             from .model import save_checkpoint
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the training metric every `period`
+    batches (reference: callback.py log_train_metric)."""
+    p = int(max(1, period))
+
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.nbatch % p:
+            return
+        for name, value in _metric_pairs(param):
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
     return _callback
 
 
 class Speedometer:
-    """Logs training speed + metrics every `frequent` batches
-    (reference: callback.py Speedometer)."""
+    """Batch-end callback logging samples/sec (+ metrics) every
+    `frequent` batches (reference: callback.py Speedometer)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._meter = _RateMeter(batch_size, frequent)
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (
-                    time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        speed = self._meter.observe(count)
+        if speed is None:
+            return
+        pairs = _metric_pairs(param)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            tail = "".join("\t%s=%f" % kv for kv in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, tail)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
 
 
 class ProgressBar:
-    """ASCII progress bar (reference: callback.py ProgressBar)."""
+    """Batch-end ASCII progress bar (reference: callback.py ProgressBar)."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = param.nbatch / float(self.total)
+        filled = int(round(self.bar_len * frac))
+        bar = ("=" * filled).ljust(self.bar_len, "-")
+        sys.stdout.write("[%s] %d%%\r" % (bar, -(-100.0 * frac // 1)))
 
 
 class LogValidationMetricsCallback:
+    """Epoch-end callback logging validation metrics (reference:
+    callback.py LogValidationMetricsCallback)."""
+
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
-                         value)
+        for name, value in _metric_pairs(param):
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -1,15 +1,20 @@
 """Gluon Trainer: applies an Optimizer to a set of Parameters.
 
-Reference: python/mxnet/gluon/trainer.py (init kvstore :135-148, step :241,
-_allreduce_grads :291-298, _update :334).
+API parity with the reference Trainer (python/mxnet/gluon/trainer.py:
+step :241, allreduce_grads :276, update :314, save/load_states :371).
 
 TPU-native notes: in the reference, step() pushes each grad to KVStore
 (multi-GPU reduce) and pulls it back, then updates per-device replicas.
 Here parameters hold single (possibly mesh-sharded) arrays; the kvstore
 push/pull is the cross-process psum when running under `tpu_dist`
-(jax.distributed), and a no-op reduce in single-process mode — XLA already
-summed the batch gradient. The optimizer update itself is a jit-compiled
-fused kernel per parameter (optimizer.py).
+(jax.distributed), and a no-op reduce in single-process mode — XLA
+already summed the batch gradient. The optimizer update itself is a
+jit-compiled fused kernel per parameter (optimizer.py).
+
+Internally the sync strategy is resolved ONCE into two booleans
+(_reduce_via_kv / _update_via_kv) by _resolve_sync(), and every
+gradient walk goes through _trainable() — a different decomposition
+from the reference's per-call branching.
 """
 from __future__ import annotations
 
@@ -20,6 +25,23 @@ from .parameter import ParameterDict, Parameter
 __all__ = ["Trainer"]
 
 
+def _normalize_params(params):
+    """Accept dict/ParameterDict/list-of-Parameter; reject the rest
+    with the reference's error wording."""
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            "First argument must be a list or dict of Parameters, "
+            "got %s." % (type(params)))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got list of %s." % (type(p)))
+    return list(params)
+
+
 class Trainer:
     """Applies an Optimizer on a set of Parameters
     (reference: trainer.py:28)."""
@@ -27,75 +49,60 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % (type(params)))
-        self._params = []
-        self._param2idx = {}
-        for i, param in enumerate(params):
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % (type(param)))
-            self._param2idx[param.name] = i
-            self._params.append(param)
+        self._params = _normalize_params(params)
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
-        self._contains_sparse_weight = any(
-            p._stype != "default" for p in self._params)
-        self._contains_sparse_grad = any(
-            p._grad_stype != "default" for p in self._params)
-        self._kvstore_params = {
-            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
-        self._kv_initialized = False
+        opt_kw = dict(optimizer_params or {})
+        self._scale = float(opt_kw.get("rescale_grad", 1.0))
+        self._kvstore_spec = (kvstore, update_on_kvstore)
         self._kvstore = None
-        self._update_on_kvstore = None
-        self._params_to_init = []
-        self._init_optimizer(optimizer, optimizer_params)
-
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
-        if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an " \
-                "Optimizer instance"
-            self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
-        else:
-            self._optimizer = opt.create(optimizer,
-                                         param_dict=param_dict,
-                                         **optimizer_params)
+        self._reduce_via_kv = False
+        self._update_via_kv = False
+        self._ready = False
+        self._optimizer = self._make_optimizer(optimizer, opt_kw)
         self._updaters = [opt.get_updater(self._optimizer)]
 
-    def _init_kvstore(self):
-        config = self._kvstore_params
-        kvstore = config["kvstore"]
-        update_on_kvstore = config["update_on_kvstore"]
-        if kvstore and not isinstance(kvstore, str):
-            self._kvstore = kvstore
-        elif kvstore:
-            self._kvstore = _create_kvstore(kvstore)
-        else:
-            self._kvstore = None
+    # -- construction ---------------------------------------------------
+    def _make_optimizer(self, optimizer, opt_kw):
+        param_dict = dict(enumerate(self._params))
+        if isinstance(optimizer, opt.Optimizer):
+            assert not opt_kw, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            optimizer.param_dict = param_dict
+            return optimizer
+        return opt.create(optimizer, param_dict=param_dict, **opt_kw)
+
+    def _resolve_sync(self):
+        """Materialize the kvstore (if any) and decide, once, where
+        reduction and updates happen. Runs lazily on first use so
+        deferred-shape parameters can finish initializing first."""
+        spec, on_kv = self._kvstore_spec
+        if spec:
+            self._kvstore = spec if not isinstance(spec, str) \
+                else _create_kvstore(spec)
         if self._kvstore is not None:
             if self._compression_params:
                 self._kvstore.set_gradient_compression(
                     self._compression_params)
-            if update_on_kvstore is None:
-                update_on_kvstore = False
-            self._update_on_kvstore = update_on_kvstore
-            if update_on_kvstore:
+            self._reduce_via_kv = True
+            self._update_via_kv = bool(on_kv)
+            if self._update_via_kv:
                 self._kvstore.set_optimizer(self._optimizer)
             for i, param in enumerate(self._params):
                 self._kvstore.init(i, param.data())
-        else:
-            self._update_on_kvstore = False
-        self._kv_initialized = True
+        self._ready = True
 
+    def _ensure_ready(self):
+        if not self._ready:
+            self._resolve_sync()
+
+    def _trainable(self):
+        """(slot, param) pairs that actually carry gradients."""
+        return [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+
+    # -- public knobs ---------------------------------------------------
     @property
     def learning_rate(self):
         if not isinstance(self._optimizer, opt.Optimizer):
@@ -110,82 +117,75 @@ class Trainer:
                               "learning rate is mutated.")
         self._optimizer.set_learning_rate(lr)
 
+    # -- the step -------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
-        """Makes one optimization step: allreduce grads, update params
+        """One optimization step: reduce grads, then update params
         (reference: trainer.py:241)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ensure_ready()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        self._reduce()
+        self._apply_updates()
 
     def allreduce_grads(self):
         """Reduce gradients over devices/workers without updating
         (reference: trainer.py:276)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
-            "allreduce_grads() when parameters are updated on kvstore is " \
-            "not supported."
-        self._allreduce_grads()
-
-    def _allreduce_grads(self):
-        if self._kvstore is None:
-            return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad(), priority=-i,
-                                       ignore_sparse=False)
+        self._ensure_ready()
+        assert not (self._kvstore and self._update_via_kv), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported."
+        self._reduce()
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Updates parameters from already-reduced gradients
         (reference: trainer.py:314)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
+        self._ensure_ready()
+        assert not (self._kvstore and self._update_via_kv), \
             "update() when parameters are updated on kvstore is not " \
             "supported."
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        self._apply_updates()
 
-    def _update(self, ignore_stale_grad=False):
-        if self._kvstore and self._update_on_kvstore:
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    self._kvstore.pull(i, param.list_data(), priority=-i)
+    def _reduce(self):
+        if not self._reduce_via_kv:
+            return
+        for i, param in self._trainable():
+            self._kvstore.push(i, param.list_grad(), priority=-i)
+            if not self._update_via_kv:
+                self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                   ignore_sparse=False)
+
+    def _apply_updates(self):
+        if self._update_via_kv:
+            for i, param in self._trainable():
+                self._kvstore.pull(i, param.list_data(), priority=-i)
             return
         for updater in self._updaters:
-            for i, param in enumerate(self._params):
-                if param.grad_req == "null":
-                    continue
+            for i, param in self._trainable():
                 updater(i, param.grad(), param.data())
 
+    # -- state io -------------------------------------------------------
     def save_states(self, fname):
         """Saves trainer (optimizer/updater) states
         (reference: trainer.py:371)."""
         assert self._optimizer is not None
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(
-                    dump_optimizer=True))
+        self._ensure_ready()
+        if self._update_via_kv:
+            self._kvstore.save_optimizer_states(fname,
+                                                dump_optimizer=True)
+            return
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         """Loads trainer states (reference: trainer.py:394)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._update_on_kvstore:
+        self._ensure_ready()
+        if self._update_via_kv:
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer
-        else:
-            with open(fname, "rb") as f:
-                states = f.read()
-            for updater in self._updaters:
-                updater.set_states(states)
-                updater.optimizer = self._updaters[0].optimizer
-            self._optimizer = self._updaters[0].optimizer
+            return
+        with open(fname, "rb") as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._updaters[0].optimizer
+        self._optimizer = self._updaters[0].optimizer

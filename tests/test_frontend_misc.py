@@ -93,3 +93,60 @@ def test_attrscope_get_unentered_returns_own_attrs():
     s = mx.AttrScope(x="y", z="1")
     assert s.get() == {"x": "y", "z": "1"}
     assert s.get({"z": "9"}) == {"x": "y", "z": "9"}
+
+
+def test_callbacks_behavior(caplog, capsys):
+    """Speedometer/log_train_metric/ProgressBar/do_checkpoint behavior
+    (reference: python/mxnet/callback.py semantics)."""
+    import logging
+    from collections import namedtuple
+    import mxnet_tpu as mx
+
+    Param = namedtuple("Param", ["epoch", "nbatch", "eval_metric"])
+
+    class FakeMetric:
+        def __init__(self):
+            self.resets = 0
+        def get_name_value(self):
+            return [("acc", 0.5)]
+        def reset(self):
+            self.resets += 1
+
+    m = FakeMetric()
+    sp = mx.callback.Speedometer(batch_size=4, frequent=2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        for nb in (1, 2, 3, 4):
+            sp(Param(0, nb, m))
+    msgs = [r.message for r in caplog.records]
+    # boundaries at nbatch 2 and 4 -> two reports, metric reset twice
+    assert len(msgs) == 2 and all("samples/sec" in s and "acc" in s
+                                  for s in msgs)
+    assert m.resets == 2
+    caplog.clear()
+
+    # epoch rollover re-arms without logging
+    with caplog.at_level(logging.INFO):
+        sp(Param(1, 1, m))
+        sp(Param(1, 2, m))
+    assert len(caplog.records) == 1  # only the new boundary at nbatch 2
+
+    with caplog.at_level(logging.INFO):
+        caplog.clear()
+        cb = mx.callback.log_train_metric(period=2)
+        cb(Param(0, 2, m))
+        cb(Param(0, 3, m))
+    assert len(caplog.records) == 1 and "Train-acc" in caplog.records[0].message
+
+    bar = mx.callback.ProgressBar(total=4, length=8)
+    bar(Param(0, 2, None))
+    outp = capsys.readouterr().out
+    assert "[====----] 50%" in outp
+
+    saved = []
+    class FakeMod:
+        def save_checkpoint(self, prefix, epoch, sos=False):
+            saved.append(epoch)
+    cb = mx.callback.module_checkpoint(FakeMod(), "p", period=2)
+    for e in range(4):
+        cb(e)
+    assert saved == [2, 4]
