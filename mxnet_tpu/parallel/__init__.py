@@ -22,10 +22,12 @@ from .pipeline import pipeline_apply
 from .moe import moe_ffn, moe_ffn_dense, moe_gating, ExpertParallelMoE
 from .kvstore_dist import DistKVStore, init_distributed
 from . import checkpoint  # sharded/async TrainerCheckpoint (orbax)
+from .prefetch import DevicePrefetcher, stage_databatch
 
 __all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
            "put_sharded", "use_mesh", "current_mesh", "Mesh",
            "NamedSharding", "PartitionSpec", "ShardedTrainer",
            "ring_attention", "local_attention", "RingAttention",
            "pipeline_apply", "moe_ffn", "moe_ffn_dense", "moe_gating",
-           "ExpertParallelMoE", "DistKVStore", "init_distributed"]
+           "ExpertParallelMoE", "DistKVStore", "init_distributed",
+           "DevicePrefetcher", "stage_databatch"]

@@ -398,6 +398,62 @@ class ShardedTrainer:
         self._step_count += int(n_steps)
         return NDArray(losses)
 
+    # -- input staging / fit loop ---------------------------------------
+    def _stage_inputs(self, parts):
+        """device_put a batch's arrays with this trainer's input
+        shardings; returns NDArrays so step() reuses the staged buffers
+        (device_put on an already-placed array is an alias, not a
+        copy)."""
+        staged = []
+        for x in parts:
+            arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            staged.append(NDArray(jax.device_put(
+                arr, self._batch_sharding(arr.ndim))))
+        return staged
+
+    def prefetched(self, data_iter, depth=2):
+        """Wrap an iterable of batches into a host→device double buffer
+        (reference: src/io/iter_prefetcher.h): a background thread
+        pulls and stages batch k+1..k+depth while step k runs. Batches
+        may be DataBatch objects or (data..., label...) tuples matching
+        this trainer's input names."""
+        from .prefetch import DevicePrefetcher
+
+        def stage(batch):
+            if hasattr(batch, "data") and hasattr(batch, "label"):
+                parts = list(batch.data) + list(batch.label or [])
+            elif isinstance(batch, (tuple, list)):
+                parts = list(batch)
+            else:
+                parts = [batch]
+            return self._stage_inputs(parts)
+
+        return DevicePrefetcher(data_iter, stage, depth)
+
+    def fit(self, data_iter, num_epochs=1, prefetch_depth=2,
+            batch_end_callback=None):
+        """Epoch loop over a DataIter with device-side double buffering
+        (async device_put of batch k+1 overlapping step k). Returns the
+        final loss NDArray."""
+        loss = None
+        if num_epochs > 1 and not hasattr(data_iter, "reset"):
+            raise MXNetError(
+                "fit(num_epochs=%d) needs a resettable DataIter; a "
+                "plain iterator/generator is exhausted after one "
+                "epoch" % num_epochs)
+        for epoch in range(num_epochs):
+            if hasattr(data_iter, "reset"):
+                data_iter.reset()
+            pf = self.prefetched(data_iter, depth=prefetch_depth)
+            try:
+                for nbatch, staged in enumerate(pf):
+                    loss = self.step(*staged)
+                    if batch_end_callback is not None:
+                        batch_end_callback(epoch, nbatch, loss)
+            finally:
+                pf.close()
+        return loss
+
     def _build_step_compressed(self):
         """Compressed-DP step: shard_map over the dp axis with an explicit
         quantize -> all_gather(packed) -> dequantize+sum gradient

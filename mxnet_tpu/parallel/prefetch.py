@@ -1,0 +1,121 @@
+"""Host→device double buffering for input pipelines.
+
+Reference role: src/io/iter_prefetcher.h (PrefetcherIter — a
+background thread keeps `prefetch_buffer` batches decoded ahead of the
+consumer) and the device-staging half of the reference's
+`--use-device-mem` training loops.
+
+TPU-native design: `jax.device_put` is asynchronous (the host→HBM DMA
+runs in the background), so true double buffering only needs the
+*iterator pull + staging call* off the critical path: a daemon thread
+pulls batch k+1..k+depth from the (possibly slow: JPEG decode,
+augmentation) iterator and issues their device_put with the right
+`NamedSharding` while step k executes. The consumer then dispatches
+step k+1 on buffers whose transfer has already started — or finished.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+__all__ = ["DevicePrefetcher"]
+
+_END = object()
+
+
+class DevicePrefetcher:
+    """Iterate `source`, running `stage(item)` on a background thread,
+    keeping up to `depth` staged items ready (reference:
+    iter_prefetcher.h, default buffer depth 4; here 2 = classic double
+    buffering).
+
+    Exceptions in the source/stage propagate to the consumer at the
+    point of `next()`. The thread is a daemon and also shuts down
+    cleanly via `close()` (or exhausting the iterator).
+    """
+
+    def __init__(self, source, stage=None, depth=2):
+        if depth < 1:
+            raise ValueError("DevicePrefetcher: depth must be >= 1")
+        self._source = iter(source)
+        self._stage = stage or (lambda x: x)
+        self._q = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                staged = self._stage(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._q.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is _END:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    next = __next__  # DataIter-style alias
+
+    def close(self):
+        """Stop the background thread without draining the source."""
+        self._stop.set()
+        # unblock a worker waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def stage_databatch(batch):
+    """Stage one io.DataBatch's arrays onto the default device (the
+    stage fn Module.fit uses; sharded trainers use
+    ShardedTrainer.prefetched, which also applies input shardings).
+
+    Returns a NEW DataBatch: iterators that recycle one batch object
+    (the reference PrefetcherIter copies into its own buffers for the
+    same reason) must not see batch k's arrays swapped while the
+    consumer still trains on them."""
+    if isinstance(batch, list):  # pre-sliced multi-batch: stage each
+        return [stage_databatch(b) for b in batch]
+    if not hasattr(batch, "data"):
+        return batch
+    import jax
+    import jax.numpy as jnp
+    from ..io import DataBatch
+    from ..ndarray import NDArray
+
+    def put(x):
+        arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        return NDArray(jax.device_put(arr))
+
+    return DataBatch(
+        data=([put(d) for d in batch.data]
+              if batch.data is not None else None),
+        label=([put(d) for d in batch.label]
+               if batch.label is not None else None),
+        pad=batch.pad, index=batch.index,
+        bucket_key=getattr(batch, "bucket_key", None),
+        provide_data=getattr(batch, "provide_data", None),
+        provide_label=getattr(batch, "provide_label", None))
