@@ -1,17 +1,20 @@
-"""Minimal SSD-style detector on synthetic boxes
-(reference: example/ssd/ — MultiBoxPrior/Target/Detection pipeline,
-SURVEY.md N5d).
+"""Minimal SSD-style detector trained from the detection input path
+(reference: example/ssd/ — MultiBoxPrior/Target/Detection pipeline fed
+by ImageDetIter over a detection record file, SURVEY.md N5d/N10;
+python/mxnet/image/detection.py:625, src/io/iter_image_det_recordio.cc).
 
-A tiny conv backbone predicts class scores + box offsets per anchor;
-targets come from contrib.MultiBoxTarget; detection decodes + NMS via
-contrib.MultiBoxDetection. Synthetic scenes contain one bright square on
-a dark background.
+The example packs synthetic scenes (one bright square on a dark
+background) into a real .rec with per-image detection labels, then
+trains end-to-end from mx.image.ImageDetIter: decode -> label-aware
+augmentation (random mirror) -> fixed-shape padded labels ->
+MultiBoxTarget -> losses.
 
 Usage: python train_ssd.py [--steps 60] [--cpu]
 """
 import argparse
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))  # run from a source checkout
@@ -20,14 +23,32 @@ import numpy as np
 
 
 def make_scene(rng, size=32):
-    img = np.zeros((3, size, size), np.float32)
+    """HWC uint8 image + normalized [cls, x1, y1, x2, y2] box."""
+    img = np.zeros((size, size, 3), np.uint8)
     w = rng.randint(8, 16)
     x0 = rng.randint(0, size - w)
     y0 = rng.randint(0, size - w)
-    img[:, y0:y0 + w, x0:x0 + w] = 1.0
+    img[y0:y0 + w, x0:x0 + w, :] = 255
     box = np.array([0, x0 / size, y0 / size, (x0 + w) / size,
                     (y0 + w) / size], np.float32)
     return img, box
+
+
+def build_det_record(mx, path, n_images, rng, size=32):
+    """Pack scenes into an indexed .rec whose headers carry detection
+    labels [header_w=2, obj_w=5, cls, x1, y1, x2, y2] — the det-record
+    format ImageDetIter consumes (iter_image_det_recordio.cc role)."""
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    boxes = []
+    for i in range(n_images):
+        img, box = make_scene(rng, size)
+        label = np.concatenate([[2, 5], box]).astype(np.float32)
+        hdr = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack_img(hdr, img, quality=95))
+        boxes.append(box)
+    rec.close()
+    return path + ".rec", boxes
 
 
 def main():
@@ -84,25 +105,38 @@ def main():
     cls_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
     box_loss = gluon.loss.HuberLoss()
 
+    # the detection input path: det .rec -> ImageDetIter batches
     rng = np.random.RandomState(0)
-    for step in range(args.steps):
-        imgs, boxes = zip(*[make_scene(rng)
-                            for _ in range(args.batch_size)])
-        x = mx.nd.array(np.stack(imgs))
-        label = mx.nd.array(np.stack(boxes)[:, None, :])  # (B,1,5)
-        with autograd.record():
-            anchors, cls, box = net(x)
-            bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, label,
-                                                      cls)
-            l = cls_loss(cls, ct) + box_loss(box * bm, bt * bm)
-        l.backward()
-        trainer.step(args.batch_size)
-        if step % 10 == 0:
-            print("step %d loss %.4f" % (step,
-                                         float(l.mean().asscalar())))
+    tmpdir = tempfile.mkdtemp(prefix="ssd_rec_")
+    rec_path, _ = build_det_record(
+        mx, os.path.join(tmpdir, "scenes"), 4 * args.batch_size, rng)
+    det_iter = mx.image.ImageDetIter(
+        batch_size=args.batch_size, data_shape=(3, 32, 32),
+        path_imgrec=rec_path, shuffle=True, rand_mirror=True)
+
+    step = 0
+    while step < args.steps:
+        det_iter.reset()
+        for batch in det_iter:
+            if step >= args.steps:
+                break
+            x = batch.data[0] / 255.0
+            label = batch.label[0]  # (B, max_obj, 5), -1-padded
+            with autograd.record():
+                anchors, cls, box = net(x)
+                bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, label,
+                                                          cls)
+                l = cls_loss(cls, ct) + box_loss(box * bm, bt * bm)
+            l.backward()
+            trainer.step(args.batch_size)
+            if step % 10 == 0:
+                print("step %d loss %.4f" % (step,
+                                             float(l.mean().asscalar())))
+            step += 1
 
     # detect on one scene
     img, box = make_scene(rng)
+    img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
     anchors, cls, boxp = net(mx.nd.array(img[None]))
     probs = mx.nd.softmax(cls, axis=1)
     det = mx.nd.contrib.MultiBoxDetection(probs, boxp, anchors,

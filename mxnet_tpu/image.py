@@ -539,3 +539,12 @@ class ImageIter(DataIter):
         return DataBatch(data=[array(batch_data)],
                          label=[array(batch_label)], pad=pad,
                          index=None)
+
+
+# detection pipeline (reference: python/mxnet/image/detection.py is
+# re-exported through the mx.image namespace); imported last to avoid
+# a cycle — image_det uses this module's augmenters/decoders
+from .image_det import (  # noqa: E402,F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+    DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    CreateMultiRandCropAugmenter, CreateDetAugmenter, ImageDetIter)

@@ -1,0 +1,163 @@
+"""Detection input path: label-aware augmenters + ImageDetIter over a
+real packed record file (reference: python/mxnet/image/detection.py,
+src/io/iter_image_det_recordio.cc; reference tests:
+tests/python/unittest/test_image.py TestImageDetIter)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.image_det import (DetHorizontalFlipAug, DetRandomCropAug,
+                                 DetRandomPadAug, CreateDetAugmenter)
+
+RNG = np.random.RandomState(5)
+
+
+def _scene(size=32, n_obj=1):
+    img = np.zeros((size, size, 3), np.uint8)
+    objs = []
+    for _ in range(n_obj):
+        w = RNG.randint(8, 16)
+        x0 = RNG.randint(0, size - w)
+        y0 = RNG.randint(0, size - w)
+        img[y0:y0 + w, x0:x0 + w] = RNG.randint(100, 255)
+        objs.append([0, x0 / size, y0 / size, (x0 + w) / size,
+                     (y0 + w) / size])
+    return img, np.asarray(objs, np.float32)
+
+
+def _write_rec(path, n=8, max_obj=3):
+    rec = recordio.MXIndexedRecordIO(str(path) + ".idx",
+                                     str(path) + ".rec", "w")
+    for i in range(n):
+        img, objs = _scene(n_obj=RNG.randint(1, max_obj + 1))
+        label = np.concatenate([[2, 5], objs.ravel()]).astype(np.float32)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img))
+    rec.close()
+    return str(path) + ".rec"
+
+
+def test_flip_aug_label_math():
+    img, objs = _scene()
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(nd.array(img), objs)
+    # x-extent mirrors, y untouched, width preserved
+    assert np.allclose(lab[:, 1], 1.0 - objs[:, 3], atol=1e-6)
+    assert np.allclose(lab[:, 3], 1.0 - objs[:, 1], atol=1e-6)
+    assert np.allclose(lab[:, (2, 4)], objs[:, (2, 4)])
+    # the image flipped too: flipping back restores it
+    assert np.array_equal(np.asarray(out.asnumpy(), np.uint8)[:, ::-1],
+                          img)
+
+
+def test_random_crop_respects_constraints():
+    img, objs = _scene(size=64, n_obj=2)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.3, 1.0), max_attempts=40)
+    hit = False
+    for _ in range(10):
+        out, lab = aug(nd.array(img), objs)
+        assert lab.shape[1] == 5 and lab.shape[0] >= 1
+        assert (lab[:, 1:5] >= -1e-6).all() and (lab[:, 1:5] <= 1 + 1e-6).all()
+        assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+        if out.shape != img.shape:
+            hit = True
+            s = out.shape
+            assert 0.3 * 64 * 64 <= s[0] * s[1] <= 64 * 64 * 1.02
+    assert hit, "crop never fired in 10 tries"
+
+
+def test_random_pad_shrinks_boxes():
+    img, objs = _scene(size=32)
+    aug = DetRandomPadAug(area_range=(2.0, 3.0), max_attempts=50)
+    out, lab = aug(nd.array(img), objs)
+    assert out.shape[0] >= 32 and out.shape[1] >= 32
+    # areas shrink by the canvas growth factor
+    def area(b):
+        return (b[:, 3] - b[:, 1]) * (b[:, 4] - b[:, 2])
+    growth = (out.shape[0] * out.shape[1]) / (32.0 * 32.0)
+    assert np.allclose(area(lab) * growth, area(objs), rtol=0.05)
+
+
+def test_create_det_augmenter_chain():
+    augs = CreateDetAugmenter((3, 24, 24), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, brightness=0.1)
+    img, objs = _scene()
+    out, lab = img, objs
+    out = nd.array(out)
+    for a in augs:
+        out, lab = a(out, lab)
+    # chain always lands on the network input size
+    assert tuple(out.shape) == (24, 24, 3)
+    assert lab.shape[1] == 5
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    rec = _write_rec(tmp_path / "scenes", n=8, max_obj=3)
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                               path_imgrec=rec, shuffle=True,
+                               rand_mirror=True)
+    # fixed, padded label geometry across the dataset
+    assert it.provide_label[0].shape[1:] == (it.max_objects, 5)
+    n_batches = 0
+    for batch in it:
+        x, y = batch.data[0], batch.label[0]
+        assert x.shape == (4, 3, 32, 32)
+        assert y.shape == (4, it.max_objects, 5)
+        yn = y.asnumpy()
+        # padding rows are -1; real rows have valid geometry
+        real = yn[yn[:, :, 0] >= 0]
+        assert real.shape[0] >= 4  # at least one object per image
+        assert (real[:, 3] > real[:, 1]).all()
+        n_batches += 1
+    assert n_batches == 2
+
+    # reshape to a larger padded label and iterate again
+    it.reshape(label_shape=(it.max_objects + 2, 5))
+    it.reset()
+    b = next(iter(it))
+    assert b.label[0].shape[1] == it.max_objects
+
+    # feeds MultiBoxTarget directly (the SSD training path)
+    anchors = mx.nd.contrib.MultiBoxPrior(nd.zeros((1, 8, 8, 8)),
+                                          sizes=(0.3,), ratios=(1.0,))
+    cls = nd.zeros((4, 2, anchors.shape[1]))
+    bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, b.label[0], cls)
+    assert np.isfinite(bt.asnumpy()).all()
+
+
+def test_sync_label_shape(tmp_path):
+    r1 = _write_rec(tmp_path / "a", n=4, max_obj=1)
+    r2 = _write_rec(tmp_path / "b", n=4, max_obj=3)
+    it1 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                                path_imgrec=r1)
+    it2 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                                path_imgrec=r2)
+    it2 = it1.sync_label_shape(it2)
+    assert it1.max_objects == it2.max_objects
+    assert it1.provide_label[0].shape == it2.provide_label[0].shape
+
+
+def test_draw_next(tmp_path):
+    rec = _write_rec(tmp_path / "d", n=2)
+    it = mx.image.ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                               path_imgrec=rec)
+    imgs = list(it.draw_next(color=(255, 0, 0), thickness=1))
+    assert len(imgs) == 2 and imgs[0].shape == (32, 32, 3)
+    assert (imgs[0] == np.array([255, 0, 0])).all(axis=-1).any()
+
+
+def test_invalid_labels_raise(tmp_path):
+    it_args = dict(batch_size=1, data_shape=(3, 32, 32))
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "bad.idx"),
+                                     str(tmp_path / "bad.rec"), "w")
+    img, _ = _scene()
+    # header claims obj_w=4 (< 5): must be rejected
+    label = np.asarray([2, 4, 0, 0.1, 0.2, 0.3, 0.4], np.float32)
+    rec.write_idx(0, recordio.pack_img(recordio.IRHeader(0, label, 0, 0),
+                                       img))
+    rec.close()
+    with pytest.raises(mx.MXNetError, match="invalid detection label"):
+        mx.image.ImageDetIter(path_imgrec=str(tmp_path / "bad.rec"),
+                              **it_args)
