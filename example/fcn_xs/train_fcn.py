@@ -1,0 +1,117 @@
+"""FCN-style semantic segmentation on synthetic shape scenes
+(reference: example/fcn-xs/ — fully-convolutional nets with a
+Deconvolution upsampling head and per-pixel softmax, fcn_xs.py +
+symbol_fcnxs.py).
+
+Scenes contain a bright square (class 1) and a dim disk (class 2) on a
+dark background (class 0); the net is a small conv encoder, a stride-2
+downsample, and a Conv2DTranspose (Deconvolution) decoder with an
+encoder skip — the fcn-8s pattern at toy scale. Trains with per-pixel
+SoftmaxCrossEntropy; asserts pixel accuracy.
+
+Usage: python train_fcn.py [--steps 80] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def make_scene(rng, size=32):
+    img = np.zeros((size, size, 1), np.float32)
+    seg = np.zeros((size, size), np.int32)
+    # square -> class 1
+    w = rng.randint(6, 12)
+    x0, y0 = rng.randint(0, size - w, size=2)
+    img[y0:y0 + w, x0:x0 + w, 0] = 1.0
+    seg[y0:y0 + w, x0:x0 + w] = 1
+    # disk -> class 2
+    r = rng.randint(4, 7)
+    cx, cy = rng.randint(r, size - r, size=2)
+    yy, xx = np.mgrid[0:size, 0:size]
+    disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+    img[disk, 0] = 0.5
+    seg[disk] = 2
+    return img.transpose(2, 0, 1), seg
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=80)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+
+    n_class = 3
+
+    class TinyFCN(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.enc1 = nn.Conv2D(16, 3, padding=1,
+                                      activation="relu")
+                self.down = nn.Conv2D(32, 3, strides=2, padding=1,
+                                      activation="relu")
+                self.mid = nn.Conv2D(32, 3, padding=1,
+                                     activation="relu")
+                # stride-2 transposed conv back to full resolution
+                self.up = nn.Conv2DTranspose(16, 4, strides=2,
+                                             padding=1)
+                self.head = nn.Conv2D(n_class, 1)
+
+        def forward(self, x):
+            skip = self.enc1(x)
+            h = self.mid(self.down(skip))
+            h = mx.nd.relu(self.up(h) + skip)  # fcn-xs skip fusion
+            return self.head(h)  # (B, n_class, H, W)
+
+    net = TinyFCN()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    rng = np.random.RandomState(0)
+
+    def batch():
+        imgs, segs = zip(*[make_scene(rng)
+                           for _ in range(args.batch_size)])
+        return (mx.nd.array(np.stack(imgs)),
+                mx.nd.array(np.stack(segs).astype(np.float32)))
+
+    def pixel_acc():
+        x, seg = batch()
+        pred = net(x).asnumpy().argmax(axis=1)
+        return float((pred == seg.asnumpy()).mean())
+
+    acc0 = pixel_acc()
+    for step in range(args.steps):
+        x, seg = batch()
+        with autograd.record():
+            out = net(x)
+            l = loss_fn(out, seg)
+        l.backward()
+        trainer.step(args.batch_size)
+        if step % 20 == 0:
+            print("step %d loss %.4f" % (step,
+                                         float(l.mean().asscalar())))
+    acc1 = pixel_acc()
+    print("pixel-acc %.3f -> %.3f" % (acc0, acc1))
+    assert acc1 > 0.9 and acc1 > acc0, "segmentation did not learn"
+    print("final pixel-acc %.3f" % acc1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Neural style by input-gradient optimization (reference:
+example/neural-style/ — optimize the IMAGE, not the weights: content
+loss on deep features + style loss on Gram matrices, gradients taken
+w.r.t. the input pixels).
+
+Uses a small fixed (random, frozen) conv feature extractor as the
+"VGG": layers conv1/conv2 give style Grams, conv3 gives content. The
+canvas starts from noise and is optimized with Adam on its pixels via
+`autograd` (x.attach_grad(); backward to the input). Asserts the total
+loss drops by >80%.
+
+Usage: python neural_style.py [--steps 60] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from a source checkout
+
+import numpy as np
+
+
+def make_images(rng, size=32):
+    # content: a centered bright square; style: diagonal stripes
+    content = np.zeros((1, 3, size, size), np.float32)
+    content[:, :, 8:24, 8:24] = 1.0
+    yy, xx = np.mgrid[0:size, 0:size]
+    stripes = (((yy + xx) // 4) % 2).astype(np.float32)
+    style = np.broadcast_to(stripes, (1, 3, size, size)).copy()
+    return content, style
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--style-weight", type=float, default=10.0)
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import gluon
+
+    class Features(gluon.Block):
+        """Frozen random conv stack standing in for VGG features."""
+
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.c1 = nn.Conv2D(8, 3, padding=1)
+                self.c2 = nn.Conv2D(16, 3, strides=2, padding=1)
+                self.c3 = nn.Conv2D(32, 3, strides=2, padding=1)
+
+        def forward(self, x):
+            f1 = mx.nd.relu(self.c1(x))
+            f2 = mx.nd.relu(self.c2(f1))
+            f3 = mx.nd.relu(self.c3(f2))
+            return f1, f2, f3
+
+    feat = Features()
+    feat.initialize(mx.initializer.Xavier(magnitude=1.0))
+
+    def gram(f):
+        b, c, h, w = f.shape
+        m = f.reshape((c, h * w))
+        return nd.dot(m, m.T) / (c * h * w)
+
+    rng = np.random.RandomState(0)
+    content_img, style_img = make_images(rng)
+    cf = feat(nd.array(content_img))[2]            # content target
+    sg = [gram(f) for f in feat(nd.array(style_img))[:2]]  # style targets
+
+    canvas = nd.array(rng.rand(*content_img.shape).astype("float32"))
+    canvas.attach_grad()
+    # Adam moments for the pixel tensor (the reference uses its own
+    # lr-scheduled SGD on the image; Adam converges faster at toy size)
+    m = np.zeros(canvas.shape, np.float32)
+    v = np.zeros(canvas.shape, np.float32)
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+
+    def total_loss():
+        f1, f2, f3 = feat(canvas)
+        closs = mx.nd.mean(mx.nd.square(f3 - cf))
+        sloss = sum(mx.nd.sum(mx.nd.square(gram(f) - g))
+                    for f, g in zip((f1, f2), sg))
+        return closs + args.style_weight * sloss
+
+    first = None
+    for step in range(args.steps):
+        with autograd.record():
+            l = total_loss()
+        l.backward()
+        g = canvas.grad.asnumpy()
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** (step + 1))
+        vh = v / (1 - b2 ** (step + 1))
+        new = canvas.asnumpy() - lr * mh / (np.sqrt(vh) + eps)
+        canvas = nd.array(np.clip(new, 0.0, 1.0))
+        canvas.attach_grad()
+        cur = float(l.asscalar())
+        if first is None:
+            first = cur
+        if step % 15 == 0:
+            print("step %d loss %.5f" % (step, cur))
+    print("loss %.5f -> %.5f" % (first, cur))
+    assert cur < first * 0.2, "input optimization did not converge"
+    print("final loss %.5f" % cur)
+
+
+if __name__ == "__main__":
+    main()

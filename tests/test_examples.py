@@ -79,3 +79,19 @@ def test_sharded_resnet_example():
 def test_gluon_cifar10_example():
     out = run_example("gluon/train_cifar10.py", "--epochs", "1")
     assert "epoch 0" in out
+
+
+def test_fcn_segmentation():
+    out = run_example("fcn_xs/train_fcn.py", "--steps", "60")
+    assert "final pixel-acc" in out
+
+
+def test_cnn_text_classification():
+    out = run_example("cnn_text_classification/train_cnn_text.py",
+                      "--epochs", "4", "--n", "1024")
+    assert "final test-acc" in out
+
+
+def test_neural_style():
+    out = run_example("neural_style/neural_style.py", "--steps", "45")
+    assert "final loss" in out
