@@ -25,6 +25,8 @@ import numpy as np
 
 BASELINE_IMG_S = 363.69
 SCORE_BASELINE_FP16 = 2085.51
+INCEPTION_BASELINE = 253.68   # docs/faq/perf.md:216, V100 b128
+ALEXNET_BASELINE = 2994.32    # docs/faq/perf.md:212, V100 b256
 # env overrides exist for CI smoke only; the driver runs the defaults
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 128))
 SCORE_BATCH = int(os.environ.get("MXTPU_BENCH_SCORE_BATCH", 32))
@@ -106,53 +108,52 @@ def _probe_devices(timeout_s=180):
                      "(%s)" % (max(retries, 1), err))
 
 
-def main():
-    _apply_platform_override()
-    _probe_devices()
-    import jax
-    jax.config.update("jax_default_matmul_precision", "bfloat16")
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
-
-    n_dev = len(jax.devices())
-    mesh = make_mesh({"dp": n_dev})
-
-    net = vision.resnet50_v1(classes=1000, layout="NHWC")
-    # materialize parameters WITHOUT an eager forward (which would
-    # trigger ~180 separate accelerator compiles over the device link):
-    # symbolic shape inference + deferred-init finish. Prefer the host
-    # CPU backend for the initializer ops when it exists (it is absent
-    # under JAX_PLATFORMS=axon/tpu-only configurations).
+def _materialize(net, img, nhwc=True):
+    """Finish deferred param init WITHOUT an eager forward (which would
+    trigger ~180 separate accelerator compiles over the device link):
+    symbolic shape inference + deferred-init finish. Prefer the host
+    CPU backend for the initializer ops when it exists (it is absent
+    under JAX_PLATFORMS=axon/tpu-only configurations)."""
     import contextlib
+    import jax
+    import mxnet_tpu as mx
     try:
         mat_ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
     except Exception:
         mat_ctx = contextlib.nullcontext()
     with mat_ctx:
         net.initialize()
-        net.infer_shape(mx.nd.zeros((1, IMG, IMG, 3)))
+        shp = (1, img, img, 3) if nhwc else (1, 3, img, img)
+        net.infer_shape(mx.nd.zeros(shp))
         for p in net.collect_params().values():
             p._finish_deferred_init()
 
+
+def _train_tput(ctor, batch, img, steps, unroll, lr=0.1):
+    """Train throughput of one model: ALL timed steps run inside ONE
+    jitted lax.scan (step_many) — one dispatch per window, fenced by
+    fetching the losses to host; device_get is the only reliable fence
+    on remote/tunneled backends (block_until_ready can return before
+    remote execution completes)."""
+    import jax
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    mesh = make_mesh({"dp": len(jax.devices())})
+    net = ctor()
+    _materialize(net, img)
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
-                        {"learning_rate": 0.1, "momentum": 0.9},
+                        {"learning_rate": lr, "momentum": 0.9},
                         mesh=mesh, compute_dtype="bfloat16")
-
     rng = np.random.RandomState(0)
-    # stage the synthetic batch on-device ONCE (the input pipeline's job;
-    # re-uploading 77MB per step would measure the host link, not the TPU)
+    # stage the synthetic batch on-device ONCE (the input pipeline's
+    # job; re-uploading per step would measure the host link, not the
+    # TPU — the reference's --benchmark 1 mode does the same)
     sh = st._batch_sharding()
-    x = jax.device_put(rng.randn(BATCH, IMG, IMG, 3).astype("float32"), sh)
-    y = jax.device_put((rng.rand(BATCH) * 1000).astype("float32"), sh)
-
-    # ALL timed steps run inside ONE jitted lax.scan (step_many): one
-    # dispatch per window, forced by fetching the losses to host —
-    # device_get is the only reliable fence on remote/tunneled backends
-    # (block_until_ready can return before remote execution completes).
-    unroll = int(os.environ.get("MXTPU_BENCH_UNROLL", 10))
+    x = jax.device_put(rng.randn(batch, img, img, 3).astype("float32"),
+                       sh)
+    y = jax.device_put((rng.rand(batch) * 1000).astype("float32"), sh)
 
     def run_window(n):
         losses = st.step_many(x, y, n_steps=n, unroll=min(unroll, n))
@@ -160,11 +161,146 @@ def main():
         assert np.isfinite(out).all(), "non-finite loss in bench window"
         return out
 
-    run_window(STEPS)  # compile + warm (same shape/unroll as timed run)
+    run_window(steps)  # compile + warm (same shape/unroll as timed run)
     t0 = time.perf_counter()
-    run_window(STEPS)
+    run_window(steps)
     dt = time.perf_counter() - t0
-    img_s = BATCH * STEPS / dt
+    return batch * steps / dt, st
+
+
+def _score_tput(score_fn, tree, xs, batch, n_score=30):
+    """Inference throughput: n_score forwards in ONE jitted fori_loop;
+    each iteration perturbs the input by a function of the previous
+    logits so XLA cannot collapse the loop. The weights ride as jit
+    ARGUMENTS (a pytree), not closure constants — closure capture would
+    embed ~25M params into the jaxpr and pin their current (possibly
+    host) placement into the compiled module."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def window(tree, xb):
+        def body(i, carry):
+            xb, acc = carry
+            out = score_fn(tree, xb)
+            return (xb + out.mean().astype(xb.dtype) * 1e-12,
+                    acc + out.astype(jnp.float32).mean())
+        _, acc = jax.lax.fori_loop(0, n_score, body,
+                                   (xb, jnp.float32(0)))
+        return acc
+
+    np.asarray(jax.device_get(window(tree, xs)))  # compile
+    t0 = time.perf_counter()
+    np.asarray(jax.device_get(window(tree, xs)))
+    return batch * n_score / (time.perf_counter() - t0)
+
+
+def _extra_metrics(rng, t_start):
+    """Secondary BASELINE.md rows (docs/faq/perf.md:155,212-216):
+    inception-v3 train b128, alexnet train b256, int8 resnet50
+    scoring. Each is fenced in try/except so one failure can't cost
+    the others, and a soft deadline keeps extras from eating a driver
+    timeout that would lose the already-computed headline."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    extras = {}
+    steps = int(os.environ.get("MXTPU_BENCH_EXTRA_STEPS", 20))
+    budget = float(os.environ.get("MXTPU_BENCH_BUDGET_S", 1200))
+
+    def over_budget(name):
+        if time.perf_counter() - t_start > budget:
+            extras[name + "_skipped"] = "time budget (%ds) spent" % budget
+            return True
+        return False
+    # size overrides exist for CI smoke only; the driver runs defaults
+    inc_batch = int(os.environ.get("MXTPU_BENCH_INCEPTION_BATCH", BATCH))
+    alex_batch = int(os.environ.get("MXTPU_BENCH_ALEX_BATCH", 256))
+
+    def inception():
+        # Inception-v3 train, b128 @299^2 (V100 baseline 253.68; the
+        # 299^2 input is structural: the v3 tail pools an 8x8 map)
+        r, _ = _train_tput(
+            lambda: vision.inception_v3(classes=1000, layout="NHWC"),
+            inc_batch, 299, steps, 5)
+        extras["inception_v3_train_b%d_img_s" % inc_batch] = round(r, 2)
+        extras["inception_v3_vs_v100"] = round(r / INCEPTION_BASELINE,
+                                               3)
+
+    def alexnet():
+        # AlexNet train, b256 (V100 baseline 2994.32 at batch 16x16);
+        # small lr: no BN anywhere, lr=0.1 diverges within the window
+        r, _ = _train_tput(
+            lambda: vision.alexnet(classes=1000, layout="NHWC"),
+            alex_batch, 224, steps, 5, lr=1e-3)
+        extras["alexnet_train_b%d_img_s" % alex_batch] = round(r, 2)
+        extras["alexnet_vs_v100"] = round(r / ALEXNET_BASELINE, 3)
+
+    def int8_score():
+        # int8-quantized resnet50 scoring, b32 (the int8 subsystem's
+        # one unmeasured perf story; fp16 V100 score row = 2085.51)
+        net = vision.resnet50_v1(classes=1000)  # NCHW: quantizer's form
+        _materialize(net, IMG, nhwc=False)
+        out = net(mx.sym.var("data"))
+        aux_names = set(out.list_auxiliary_states())
+        args = {p.name: p.data() for p in net.collect_params().values()
+                if p.name not in aux_names}
+        auxs = {p.name: p.data() for p in net.collect_params().values()
+                if p.name in aux_names}
+        calib = rng.randn(SCORE_BATCH, 3, IMG, IMG).astype("float32")
+
+        from mxnet_tpu.io import NDArrayIter
+        from mxnet_tpu.contrib.quantization import quantize_model
+        qsym, qargs, qauxs = quantize_model(
+            out, args, auxs,
+            calib_data=NDArrayIter(calib, batch_size=SCORE_BATCH),
+            calib_mode="naive", quantize_mode="full", label_names=None)
+        from mxnet_tpu.graph import build_graph_fn
+        qfn, _, _, _ = build_graph_fn(qsym._entries, "predict")
+        # weights were materialized on the host backend: re-stage them
+        # on the accelerator so the jit doesn't mix device commitments
+        dev = jax.devices()[0]
+        qa = {k: jax.device_put(v._data, dev) for k, v in qargs.items()}
+        qx = {k: jax.device_put(v._data, dev) for k, v in qauxs.items()}
+
+        def score_fn(tree, xb):
+            a, x_ = tree
+            outs, _ = qfn({**a, "data": xb}, x_)
+            return outs[0]
+
+        xs = jax.device_put(calib, dev)
+        r = _score_tput(score_fn, (qa, qx), xs, SCORE_BATCH)
+        extras["int8_resnet50_score_b%d_img_s" % SCORE_BATCH] = round(r, 2)
+        extras["int8_score_vs_v100_fp16"] = round(
+            r / SCORE_BASELINE_FP16, 3)
+
+    for name, fn in (("inception_v3", inception), ("alexnet", alexnet),
+                     ("int8_score", int8_score)):
+        if over_budget(name):
+            continue
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 -- recorded, not fatal
+            extras[name + "_error"] = str(e)[:200]
+    return extras
+
+
+def main():
+    t_start = time.perf_counter()
+    _apply_platform_override()
+    _probe_devices()
+    import jax
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.graph import build_graph_fn
+
+    rng = np.random.RandomState(0)
+    unroll = int(os.environ.get("MXTPU_BENCH_UNROLL", 10))
+    img_s, st = _train_tput(
+        lambda: vision.resnet50_v1(classes=1000, layout="NHWC"),
+        BATCH, IMG, STEPS, unroll)
+    net = st._net
 
     # secondary: inference scoring at the reference's benchmark_score.py
     # config (batch 32), bf16 like the V100 fp16 row
@@ -172,47 +308,31 @@ def main():
     params = {k: (v.astype(jnp.bfloat16) if v.ndim >= 2 else v)
               for k, v in st.params.items()}
     aux = dict(st._aux)
-    from mxnet_tpu.graph import build_graph_fn
     out_sym = net(mx.sym.var("data"))
     score_fn, _, _, _ = build_graph_fn(out_sym._entries, "predict")
 
-    @jax.jit
-    def score(params, aux, xb):
-        outs, _ = score_fn({**params, "data": xb.astype(jnp.bfloat16)}, aux)
+    def fp_score(tree, xb):
+        p, a = tree
+        outs, _ = score_fn({**p, "data": xb.astype(jnp.bfloat16)}, a)
         return outs[0]
 
     xs = jax.device_put(
         rng.randn(SCORE_BATCH, IMG, IMG, 3).astype("float32"))
-    n_score = 30
+    score_img_s = _score_tput(fp_score, (params, aux), xs, SCORE_BATCH)
 
-    @jax.jit
-    def score_window(params, aux, xb):
-        # n_score forwards in one program; each iteration perturbs the
-        # input by a function of the previous logits so XLA cannot
-        # collapse the loop, mirroring a feed of distinct batches
-        def body(i, carry):
-            xb, acc = carry
-            out = score(params, aux, xb)
-            return (xb + out.mean().astype(xb.dtype) * 1e-12,
-                    acc + out.astype(jnp.float32).mean())
-        _, acc = jax.lax.fori_loop(0, n_score, body, (xb, jnp.float32(0)))
-        return acc
-
-    np.asarray(jax.device_get(score_window(params, aux, xs)))  # compile
-    t0 = time.perf_counter()
-    np.asarray(jax.device_get(score_window(params, aux, xs)))
-    sdt = time.perf_counter() - t0
-    score_img_s = SCORE_BATCH * n_score / sdt
+    extra = {
+        "score_b%d_img_s" % SCORE_BATCH: round(score_img_s, 2),
+        "score_vs_v100_fp16": round(score_img_s / SCORE_BASELINE_FP16,
+                                    3),
+    }
+    if os.environ.get("MXTPU_BENCH_EXTRAS", "1") not in ("0", "false"):
+        extra.update(_extra_metrics(rng, t_start))
 
     print(json.dumps({
         "metric": "resnet50_v1_train_throughput_b%d" % BATCH,
         "value": round(img_s, 2), "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "extra": {
-            "score_b%d_img_s" % SCORE_BATCH: round(score_img_s, 2),
-            "score_vs_v100_fp16": round(score_img_s / SCORE_BASELINE_FP16,
-                                        3),
-        }}))
+        "extra": extra}))
 
 
 if __name__ == "__main__":

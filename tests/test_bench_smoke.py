@@ -11,10 +11,16 @@ def test_bench_smoke_cpu():
     env = dict(os.environ)
     env.update(MXTPU_BENCH_PLATFORM="cpu", MXTPU_BENCH_BATCH="8",
                MXTPU_BENCH_IMG="32", MXTPU_BENCH_STEPS="2",
-               MXTPU_BENCH_SCORE_BATCH="4", MXTPU_BENCH_UNROLL="1")
+               MXTPU_BENCH_SCORE_BATCH="4", MXTPU_BENCH_UNROLL="1",
+               MXTPU_BENCH_EXTRA_STEPS="2",
+               MXTPU_BENCH_INCEPTION_BATCH="8",
+               MXTPU_BENCH_ALEX_BATCH="8",
+               # never let the in-bench budget skip extras: this test
+               # asserts their presence, so skipping must be a failure
+               MXTPU_BENCH_BUDGET_S="100000")
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
-                       capture_output=True, text=True, timeout=900,
+                       capture_output=True, text=True, timeout=1500,
                        env=env)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
@@ -22,3 +28,8 @@ def test_bench_smoke_cpu():
     assert out["metric"].startswith("resnet50_v1_train_throughput")
     assert out["value"] > 0 and out["unit"] == "img/s"
     assert "score_b4_img_s" in out["extra"]
+    # the BASELINE.md secondary rows ride along (errors would be
+    # reported under *_error keys — fail loudly here instead)
+    for key in ("inception_v3_train_b8_img_s", "alexnet_train_b8_img_s",
+                "int8_resnet50_score_b4_img_s"):
+        assert key in out["extra"], out["extra"]
