@@ -129,7 +129,7 @@ def _materialize(net, img, nhwc=True):
             p._finish_deferred_init()
 
 
-def _train_tput(ctor, batch, img, steps, unroll, lr=0.1):
+def _train_tput(ctor, batch, img, steps, unroll, lr=0.1, **trainer_kw):
     """Train throughput of one model: ALL timed steps run inside ONE
     jitted lax.scan (step_many) — one dispatch per window, fenced by
     fetching the losses to host; device_get is the only reliable fence
@@ -145,7 +145,8 @@ def _train_tput(ctor, batch, img, steps, unroll, lr=0.1):
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     st = ShardedTrainer(net, lambda o, l: loss(o, l), "sgd",
                         {"learning_rate": lr, "momentum": 0.9},
-                        mesh=mesh, compute_dtype="bfloat16")
+                        mesh=mesh, compute_dtype="bfloat16",
+                        **trainer_kw)
     rng = np.random.RandomState(0)
     # stage the synthetic batch on-device ONCE (the input pipeline's
     # job; re-uploading per step would measure the host link, not the

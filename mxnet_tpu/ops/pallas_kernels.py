@@ -30,7 +30,8 @@ except ImportError:  # pragma: no cover
 
 from .registry import register
 
-__all__ = ["flash_attention", "pallas_layer_norm"]
+__all__ = ["flash_attention", "pallas_layer_norm",
+           "fused_sgd_momentum"]
 
 _NEG_INF = -1e30
 
@@ -204,3 +205,67 @@ def pallas_layer_norm(x, gamma, beta, eps=1e-5, block_rows=128):
 def _flash_attention_op(q, k, v, *, causal=False, block_q=128,
                         block_k=128):
     return flash_attention(q, k, v, causal, block_q, block_k)
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update (PERF.md §2: the conv-dW + SGD "multiply/
+# subtract" fusion family is the dominant HBM-bound step component;
+# this kernel is the hand-written comparison point for the roofline —
+# one pass reading w/g/m and writing w'/m' at minimum possible bytes)
+# ---------------------------------------------------------------------------
+def _sgd_mom_kernel(w_ref, g_ref, m_ref, ow_ref, om_ref, *, lr,
+                    momentum, wd, rescale):
+    w = w_ref[...]
+    g = g_ref[...] * rescale + wd * w
+    m = momentum * m_ref[...].astype(g.dtype) + g
+    om_ref[...] = m.astype(om_ref.dtype)
+    ow_ref[...] = (w - lr * m.astype(w.dtype)).astype(ow_ref.dtype)
+
+
+def fused_sgd_momentum(w, g, m, lr, momentum=0.9, wd=0.0, rescale=1.0,
+                      block_rows=256):
+    """Momentum-SGD update as one Pallas pass: m' = momentum·m +
+    rescale·g + wd·w; w' = w − lr·m'. Returns (w', m').
+
+    Arrays of any shape are flattened and padded to (rows, 128) VPU
+    lanes; already-aligned 2D inputs take the zero-copy path (the MFU
+    probe feeds those). m may be a wider dtype than w (fp32 momentum
+    with bf16 weights): accumulation happens in the promoted dtype and
+    each output is cast back to its input's dtype. Elementwise
+    traffic = 3 reads + 2 writes — the same as XLA's fused update, so
+    any measured win/loss against the XLA version is scheduling, not
+    algorithm (tools/mfu_probe.py records the outcome either way)."""
+    orig_shape, n = w.shape, w.size
+    cols = 128
+    # small tensors get one small block, not a 32k-element round-up
+    block_rows = max(8, min(block_rows, -(-n // cols)))
+    aligned = (w.ndim == 2 and w.shape[1] == cols
+               and w.shape[0] % block_rows == 0)
+
+    def prep(x):
+        if aligned:
+            return x
+        flat = jnp.ravel(x)
+        rows = -(-n // cols)
+        pad_rows = -(-rows // block_rows) * block_rows
+        flat = jnp.pad(flat, (0, pad_rows * cols - n))
+        return flat.reshape(pad_rows, cols)
+
+    W, G, M = prep(w), prep(g), prep(m)
+    kernel = functools.partial(_sgd_mom_kernel, lr=lr, momentum=momentum,
+                               wd=wd, rescale=rescale)
+    blocks = W.shape[0] // block_rows
+    spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0))
+    ow, om = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct(W.shape, W.dtype),
+                   jax.ShapeDtypeStruct(M.shape, M.dtype)),
+        grid=(blocks,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec),
+        interpret=_interpret(),
+    )(W, G, M)
+    if aligned:
+        return ow, om
+    unpad = lambda x: x.reshape(-1)[:n].reshape(orig_shape)  # noqa: E731
+    return unpad(ow), unpad(om)

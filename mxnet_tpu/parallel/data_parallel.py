@@ -106,7 +106,7 @@ class ShardedTrainer:
                  data_names=("data",), label_names=("label",),
                  aux_mode="train", compute_dtype=None,
                  gradient_compression=None,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, remat=False):
         """compute_dtype: e.g. "bfloat16" for mixed precision — master
         params stay fp32; weights (ndim>=2) and data inputs are cast to
         the compute dtype inside the step, so matmuls/convs hit the MXU
@@ -127,7 +127,16 @@ class ShardedTrainer:
         quantize with error feedback, all_gather of the packed words,
         local dequantize+sum), 1/16 the gradient bytes on ICI/DCN.
         Reference: src/kvstore/gradient_compression.h. Requires a pure
-        data-parallel mesh (no param_rules)."""
+        data-parallel mesh (no param_rules).
+
+        remat: rematerialize the forward during backward
+        (jax.checkpoint) instead of keeping all activations live —
+        trades ~33% more FLOPs for activation memory, the lever that
+        lets batch sizes that would spill HBM compile (reference
+        analog: MXNET_BACKWARD_DO_MIRROR, docs/faq/env_var.md). True
+        for full remat, or the name of a jax.checkpoint_policies
+        member (e.g. "dots_with_no_batch_dims_saveable") for selective
+        remat."""
         self._net = net
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
@@ -174,6 +183,17 @@ class ShardedTrainer:
         self._aux_names = [n.name for n in aux_nodes]
         self._fn, _, _, self._needs_rng = build_graph_fn(
             loss_sym._entries, aux_mode)
+        if remat:
+            if isinstance(remat, str):
+                policy = getattr(jax.checkpoint_policies, remat)
+            elif callable(remat):
+                policy = remat  # a jax.checkpoint_policies member
+            elif remat is True:
+                policy = None  # full rematerialization
+            else:
+                raise MXNetError("remat must be True, a policy name, "
+                                 "or a checkpoint policy callable")
+            self._fn = jax.checkpoint(self._fn, policy=policy)
 
         # pull initial values out of the gluon net
         net_params = {p.name: p for p in net.collect_params().values()}

@@ -1,0 +1,127 @@
+"""Round-4 MFU probes (PERF.md §5 follow-ups; run ON THE REAL CHIP in
+one generously-timed process that exits normally — never wrap in
+`timeout`, never SIGKILL: a killed holder wedges the relay lease).
+
+Probes, each isolated so one failure doesn't cost the rest:
+  1. b128 headline sanity (round-3 ladder said 2762 img/s)
+  2. batch ladder b192/b256 plain — r3 saw b256 regress (HBM spill)
+  3. b256 with remat=True / selective remat — the single-chip memory
+     lever (ZeRO-1 shards optimizer state across dp, which is a no-op
+     at dp=1; recorded as a reasoned negative, not a measurement)
+  4. fused-update roofline: XLA's fused momentum-SGD vs the Pallas
+     fused_sgd_momentum kernel on a resnet50-sized buffer, GB/s each —
+     if XLA already sits at HBM spec (~819 GB/s/chip v5e), the Pallas
+     path can't win and the negative closes PERF.md §5's question.
+
+Writes PROBE_MFU.json and prints one JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+RESULTS = {}
+
+
+def _record(name, fn):
+    t0 = time.time()
+    try:
+        RESULTS[name] = fn()
+    except Exception as e:  # noqa: BLE001 — probe isolation
+        RESULTS[name] = {"error": str(e)[:300]}
+    RESULTS[name + "_wall_s"] = round(time.time() - t0, 1)
+
+
+def _resnet():
+    from mxnet_tpu.gluon.model_zoo import vision
+    return vision.resnet50_v1(classes=1000, layout="NHWC")
+
+
+def batch_probe(batch, **kw):
+    def run():
+        import bench
+        r, _ = bench._train_tput(lambda: _resnet(), batch, 224, 50, 10,
+                                 **kw)
+        return {"img_s": round(r, 2)}
+    return run
+
+
+def update_roofline():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import fused_sgd_momentum
+
+    rows, cols = 199680, 128  # ~25.6M fp32 params, lane-aligned
+    nbytes = rows * cols * 4
+    rng = np.random.RandomState(0)
+    w = jax.device_put(rng.randn(rows, cols).astype("float32"))
+    g = jax.device_put(rng.randn(rows, cols).astype("float32"))
+    m = jax.device_put(rng.randn(rows, cols).astype("float32"))
+    lr, mom = 0.05, 0.9
+    iters = 50
+
+    def xla_step(w, g, m):
+        m2 = mom * m + g
+        return w - lr * m2, m2
+
+    def timed(step):
+        @jax.jit
+        def loop(w, g, m):
+            def body(i, c):
+                w, m = c
+                w, m = step(w, g + i * 0.0, m)
+                return (w, m)
+            return jax.lax.fori_loop(0, iters, body, (w, m))
+        out = loop(w, g, m)
+        np.asarray(jax.device_get(out[0][:1, :1]))  # compile+fence
+        t0 = time.perf_counter()
+        out = loop(w, g, m)
+        np.asarray(jax.device_get(out[0][:1, :1]))
+        dt = time.perf_counter() - t0
+        # 3 reads + 2 writes of nbytes per iteration
+        return 5.0 * nbytes * iters / dt / 1e9
+
+    xla = timed(xla_step)
+    pallas = timed(lambda w, g, m: fused_sgd_momentum(w, g, m, lr, mom))
+    return {"xla_gb_s": round(xla, 1), "pallas_gb_s": round(pallas, 1),
+            "buffer_mb": round(nbytes / 2**20, 1),
+            "note": "3R+2W bytes/iter; v5e HBM spec ~819 GB/s"}
+
+
+def main():
+    from mxnet_tpu.base import probe_devices
+    devs, err = probe_devices(timeout_s=240)
+    if devs is None:
+        print(json.dumps({"error": "backend unreachable: %s" % err}))
+        return 1
+    import jax
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    RESULTS["devices"] = [str(d) for d in devs]
+
+    _record("b128_headline", batch_probe(128))
+    _record("b192", batch_probe(192))
+    _record("b256", batch_probe(256))
+    _record("b256_remat_full", batch_probe(256, remat=True))
+    _record("b256_remat_dots",
+            batch_probe(256, remat="dots_with_no_batch_dims_saveable"))
+    RESULTS["zero1_note"] = (
+        "shard_optimizer_state (ZeRO-1) shards over the dp mesh axis; "
+        "with ONE real chip dp=1 so there is nothing to shard — "
+        "a single-chip b256 memory fix must come from remat instead")
+    _record("update_roofline", update_roofline)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "PROBE_MFU.json")
+    with open(out, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+    print(json.dumps(RESULTS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
