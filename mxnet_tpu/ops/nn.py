@@ -776,9 +776,10 @@ def _correlation(a, b, *, kernel_size=1, max_displacement=1, stride1=1,
             shifted = pb[:, :, md + dy:md + dy + hp,
                          md + dx:md + dx + wp]
             prod = pa * shifted if is_multiply else jnp.abs(pa - shifted)
-            # channel sum then K×K window sum = patch aggregate
-            plane = lax.reduce_window(prod.sum(axis=1),
-                                      jnp.zeros((), prod.dtype), lax.add,
+            # channel sum then K×K window sum = patch aggregate (init
+            # must be the LITERAL 0.0 so jax lowers to the monoid
+            # window-sum primitive, which is the differentiable one)
+            plane = lax.reduce_window(prod.sum(axis=1), 0.0, lax.add,
                                       (1, k, k), (1, 1, 1), "VALID")
             planes.append(plane[:, md:md + out_h * stride1:stride1,
                                 md:md + out_w * stride1:stride1])
