@@ -35,3 +35,14 @@ def test_launch_local_runs_dist_worker():
 def test_launch_propagates_failure():
     r = _run_launcher(2, [sys.executable, "-c", "import sys; sys.exit(7)"])
     assert r.returncode == 7
+
+
+def test_cleanup_flag():
+    """--cleanup reaps stale processes locally (and over a hostfile's
+    hosts; local-only here) — the reference kill-mxnet.py role."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "--cleanup"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "kill_stale" in r.stdout or "no stale" in r.stdout

@@ -21,6 +21,8 @@ Modes:
 
 Usage:
   tools/launch.py -n 4 python train.py --kv-store dist_sync
+  tools/launch.py -H hostfile --cleanup     # cluster-wide stale reap
+                                            # (reference kill-mxnet.py)
 """
 import argparse
 import os
@@ -114,15 +116,44 @@ def launch_ssh(hosts, n, command, env=None):
     return rc
 
 
+def cleanup(hosts):
+    """Reap stale framework processes locally and on every host
+    (reference: tools/kill-mxnet.py's pkill sweep, done through
+    tools/kill_stale.py so lease-holder protection applies per host)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    local = subprocess.run([sys.executable,
+                            os.path.join(here, "kill_stale.py"), "--kill"])
+    rc = local.returncode
+    for host in hosts:
+        remote = "cd %s && %s tools/kill_stale.py --kill" % (
+            os.path.dirname(here), sys.executable)
+        r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
+                            host, remote])
+        print("cleanup %s -> rc=%d" % (host, r.returncode))
+        rc = rc or r.returncode
+    return rc
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference tools/launch.py)")
-    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-n", "--num-workers", type=int, default=None)
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh"])
     parser.add_argument("-H", "--hostfile", default=None)
+    parser.add_argument("--cleanup", action="store_true",
+                        help="reap stale framework processes on this "
+                             "host and every --hostfile host, then exit")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    if args.cleanup:
+        hosts = []
+        if args.hostfile:
+            with open(args.hostfile) as f:
+                hosts = [h.strip().split(":")[0] for h in f if h.strip()]
+        sys.exit(cleanup(hosts))
+    if args.num_workers is None:
+        parser.error("-n/--num-workers is required (unless --cleanup)")
     if not args.command:
         parser.error("no command given")
     if args.launcher == "local":
