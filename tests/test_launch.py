@@ -38,11 +38,15 @@ def test_launch_propagates_failure():
 
 
 def test_cleanup_flag():
-    """--cleanup reaps stale processes locally (and over a hostfile's
-    hosts; local-only here) — the reference kill-mxnet.py role."""
+    """--cleanup lists stale processes locally (and over a hostfile's
+    hosts) — the reference kill-mxnet.py role. Default is list-only so
+    a test-suite run can never kill an unrelated in-flight job; --kill
+    opts into reaping."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "--cleanup"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "kill_stale" in r.stdout or "no stale" in r.stdout
+    # list mode never prints kill confirmations
+    assert "-> killed" not in r.stdout

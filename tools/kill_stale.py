@@ -28,7 +28,9 @@ Heuristics (all /proc-based, no deps):
     as a potential lease holder.
 
 Remote cleanup over a DMLC hostfile (the reference's use case) rides
-tools/launch.py's ssh plumbing: `tools/launch.py -H hostfile --cleanup`.
+tools/launch.py's ssh plumbing:
+`tools/launch.py -H hostfile --cleanup --kill` (list-only without
+--kill).
 """
 import argparse
 import os

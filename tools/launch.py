@@ -21,7 +21,7 @@ Modes:
 
 Usage:
   tools/launch.py -n 4 python train.py --kv-store dist_sync
-  tools/launch.py -H hostfile --cleanup     # cluster-wide stale reap
+  tools/launch.py -H hostfile --cleanup --kill  # cluster stale reap
                                             # (reference kill-mxnet.py)
 """
 import argparse
@@ -116,17 +116,32 @@ def launch_ssh(hosts, n, command, env=None):
     return rc
 
 
-def cleanup(hosts):
+def _read_hostfile(path):
+    """Hostfile lines may carry :port suffixes and # comments (the
+    reference accepts both); ssh wants the bare hostname."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line.split(":")[0])
+    return hosts
+
+
+def cleanup(hosts, kill=False):
     """Reap stale framework processes locally and on every host
     (reference: tools/kill-mxnet.py's pkill sweep, done through
-    tools/kill_stale.py so lease-holder protection applies per host)."""
+    tools/kill_stale.py so lease-holder protection applies per host).
+    Default is LIST-ONLY; pass kill=True (--kill on the CLI) to act.
+    Remote hosts are assumed to share this checkout's path (the same
+    contract launch_ssh already relies on) and use `python3`."""
     here = os.path.dirname(os.path.abspath(__file__))
-    local = subprocess.run([sys.executable,
-                            os.path.join(here, "kill_stale.py"), "--kill"])
-    rc = local.returncode
+    argv = [sys.executable, os.path.join(here, "kill_stale.py")]
+    mode = ["--kill"] if kill else []
+    rc = subprocess.run(argv + mode).returncode
     for host in hosts:
-        remote = "cd %s && %s tools/kill_stale.py --kill" % (
-            os.path.dirname(here), sys.executable)
+        remote = "cd %s && python3 tools/kill_stale.py %s" % (
+            os.path.dirname(here), " ".join(mode))
         r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
                             host, remote])
         print("cleanup %s -> rc=%d" % (host, r.returncode))
@@ -142,16 +157,17 @@ def main():
                         choices=["local", "ssh"])
     parser.add_argument("-H", "--hostfile", default=None)
     parser.add_argument("--cleanup", action="store_true",
-                        help="reap stale framework processes on this "
-                             "host and every --hostfile host, then exit")
+                        help="list (with --kill: reap) stale framework "
+                             "processes on this host and every "
+                             "--hostfile host, then exit")
+    parser.add_argument("--kill", action="store_true",
+                        help="with --cleanup: actually kill (default "
+                             "lists only)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.cleanup:
-        hosts = []
-        if args.hostfile:
-            with open(args.hostfile) as f:
-                hosts = [h.strip().split(":")[0] for h in f if h.strip()]
-        sys.exit(cleanup(hosts))
+        hosts = _read_hostfile(args.hostfile) if args.hostfile else []
+        sys.exit(cleanup(hosts, kill=args.kill))
     if args.num_workers is None:
         parser.error("-n/--num-workers is required (unless --cleanup)")
     if not args.command:
@@ -159,9 +175,8 @@ def main():
     if args.launcher == "local":
         rc = launch_local(args.num_workers, args.command)
     else:
-        with open(args.hostfile) as f:
-            hosts = [h.strip() for h in f if h.strip()]
-        rc = launch_ssh(hosts, args.num_workers, args.command)
+        rc = launch_ssh(_read_hostfile(args.hostfile),
+                        args.num_workers, args.command)
     sys.exit(rc)
 
 
