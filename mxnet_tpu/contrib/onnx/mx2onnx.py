@@ -85,7 +85,9 @@ class _Ctx:
         self.nodes = []
         self.initializers = []
         self.force_ones = set()  # fix_gamma: export gamma as ones
-        self._n = 0
+        self.params = {}         # caller's param arrays (RNN repacking)
+        self.drop_params = set()  # params replaced by handler-emitted
+        self._n = 0              # initializers (e.g. RNN W/R/B)
 
     def emit(self, op_type, ins, outs, name=None, **attrs):
         self._n += 1
@@ -453,6 +455,96 @@ def _add_n(ctx, node, ins, outs, p):
     ctx.emit("Sum", ins, outs, node.name)
 
 
+# mxnet fused-RNN gate orders -> ONNX orders (rows of W/R/B blocks)
+# LSTM: mx [i, f, g, o] -> onnx iofc; GRU: mx [r, z, n] -> onnx zrh
+_GATE_PERM = {"lstm": (0, 3, 1, 2), "gru": (1, 0, 2),
+              "rnn_tanh": (0,), "rnn_relu": (0,)}
+_RNN_ONNX_TYPE = {"lstm": "LSTM", "gru": "GRU", "rnn_tanh": "RNN",
+                  "rnn_relu": "RNN"}
+
+
+def _perm_gates(mat, perm, H):
+    blocks = [mat[g * H:(g + 1) * H] for g in range(len(perm))]
+    return np.concatenate([blocks[g] for g in perm], axis=0)
+
+
+@_handler("RNN")
+def _rnn(ctx, node, ins, outs, p):
+    """Fused RNN -> ONNX LSTM/GRU/RNN (reference:
+    mx2onnx/_op_translations.py convert_RNN). The mxnet flat param
+    vector is unpacked (ops/nn.py rnn_unpack_params layout) and
+    re-emitted as the per-direction W/R/B initializers with gates
+    reordered; Y (T, D, B, H) is transposed+reshaped back to the mxnet
+    (T, B, D*H) form."""
+    mode = p.get("mode", "lstm")
+    if mode not in _GATE_PERM:
+        raise MXNetError("ONNX export: RNN mode %r" % mode)
+    if int(p.get("num_layers", 1)) != 1:
+        raise MXNetError("ONNX export: fused RNN with num_layers>1 — "
+                         "export one layer per RNN op")
+    # (inter-layer dropout p is a no-op in the inference export)
+    H = int(p["state_size"])
+    bidir = bool(p.get("bidirectional", False))
+    D = 2 if bidir else 1
+    n_gates = {"lstm": 4, "gru": 3}.get(mode, 1)
+    perm = _GATE_PERM[mode]
+
+    pname = node.inputs[1][0].name
+    if pname not in ctx.params:
+        raise MXNetError("ONNX export: RNN parameter %r must be in the "
+                         "params dict" % pname)
+    flat = np.asarray(ctx.params[pname].asnumpy()
+                      if hasattr(ctx.params[pname], "asnumpy")
+                      else ctx.params[pname], np.float32).ravel()
+    ctx.drop_params.add(pname)
+    # infer input_size from the packed length:
+    # D*(g*H*in + g*H*H + 2*g*H) = len
+    gH = n_gates * H
+    in_sz = (len(flat) // D - gH * H - 2 * gH) // gH
+    Ws, Rs, Bs = [], [], []
+    off = 0
+    for _ in range(D):
+        wi = flat[off:off + gH * in_sz].reshape(gH, in_sz)
+        off += gH * in_sz
+        wh = flat[off:off + gH * H].reshape(gH, H)
+        off += gH * H
+        bi = flat[off:off + gH]
+        off += gH
+        bh = flat[off:off + gH]
+        off += gH
+        Ws.append(_perm_gates(wi, perm, H))
+        Rs.append(_perm_gates(wh, perm, H))
+        Bs.append(np.concatenate([_perm_gates(bi[:, None], perm, H),
+                                  _perm_gates(bh[:, None], perm, H)]
+                                 ).ravel())
+    W = ctx.const(node.name + "_W", np.stack(Ws))
+    R = ctx.const(node.name + "_R", np.stack(Rs))
+    B = ctx.const(node.name + "_B", np.stack(Bs))
+
+    attrs = {"hidden_size": H,
+             "direction": "bidirectional" if bidir else "forward"}
+    if mode == "gru":
+        # mxnet/cuDNN applies reset AFTER the recurrent matmul
+        attrs["linear_before_reset"] = 1
+    if mode in ("rnn_tanh", "rnn_relu"):
+        act = "Tanh" if mode == "rnn_tanh" else "Relu"
+        attrs["activations"] = [act] * D
+    # node inputs: data, params, state(, cell)
+    lstm_ins = [ins[0], W, R, B, "", ins[2]]
+    if mode == "lstm":
+        lstm_ins.append(ins[3] if len(ins) > 3 else "")
+    y_raw = node.name + "_yraw"
+    node_outs = [y_raw] + list(outs[1:])  # hT (, cT) map directly
+    ctx.emit(_RNN_ONNX_TYPE[mode], lstm_ins, node_outs, node.name,
+             **attrs)
+    # (T, D, B, H) -> (T, B, D, H) -> (T, B, D*H)
+    y_t = node.name + "_yt"
+    ctx.emit("Transpose", [y_raw], [y_t], perm=[0, 2, 1, 3])
+    shp = ctx.const(node.name + "_yshape",
+                    np.asarray([0, 0, D * H], np.int64))
+    ctx.emit("Reshape", [y_t, shp], [outs[0]])
+
+
 def _scalar_handler(onnx_type, scalar_first):
     def h(ctx, node, ins, outs, p):
         c = ctx.const(node.name + "_const",
@@ -524,6 +616,7 @@ def export_model(sym, params, input_shape, input_type=np.float32,
                          % inputs)
 
     ctx = _Ctx()
+    ctx.params = params
 
     def name_of(node, idx):
         return "%s_out%d" % (node.name, idx) if idx else node.name
@@ -550,6 +643,8 @@ def export_model(sym, params, input_shape, input_type=np.float32,
         HANDLERS[op_name](ctx, node, in_names, out_names, node.params)
 
     for pname, arr in params.items():
+        if pname in ctx.drop_params:
+            continue  # re-emitted in converted form by a handler
         a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
         if pname in ctx.force_ones:
             a = np.ones_like(a)

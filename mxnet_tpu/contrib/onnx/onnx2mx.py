@@ -476,6 +476,102 @@ def _resize(ctx, node, ins, attrs):
     return sym_mod.UpSampling(ins[0], scale=s, sample_type="nearest")
 
 
+# ONNX gate orders -> mxnet packed orders (ops/nn.py
+# rnn_unpack_params): LSTM iofc -> [i,f,g,o] = take onnx blocks
+# [0,2,3,1]; GRU zrh -> [r,z,n] = [1,0,2]
+_RNN_MODES = {"LSTM": ("lstm", 4, (0, 2, 3, 1)),
+              "GRU": ("gru", 3, (1, 0, 2)),
+              "RNN": (None, 1, (0,))}
+
+
+
+
+@_imp("LSTM", "GRU", "RNN")
+def _rnn_import(ctx, node, ins, attrs):
+    """ONNX recurrent layer -> the fused RNN op (reference:
+    onnx2mx/_op_translations.py lstm handler). W/R/B initializers are
+    repacked into the mxnet flat parameter vector with gates
+    reordered. Y is re-expressed in the ONNX (T, D, B, H) layout so
+    downstream nodes (including our own exporter's inverse
+    transpose+reshape chain) see standard semantics."""
+    from .mx2onnx import _perm_gates as _unperm_gates
+    mode, n_gates, perm = _RNN_MODES[node.op_type]
+    acts = [a.decode() if isinstance(a, bytes) else a
+            for a in (attrs.get("activations") or [])]
+    if mode is None:  # plain RNN: activation decides tanh/relu
+        acts = acts or ["Tanh"]
+        if len(set(acts)) > 1 or acts[0] not in ("Tanh", "Relu"):
+            raise MXNetError("ONNX import: RNN activations %s (the "
+                             "fused op supports uniform Tanh/Relu)"
+                             % acts)
+        mode = "rnn_relu" if acts[0] == "Relu" else "rnn_tanh"
+    elif acts:
+        raise MXNetError("ONNX import: custom %s activations %s have "
+                         "no fused-RNN equivalent"
+                         % (node.op_type, acts))
+    if attrs.get("clip"):
+        raise MXNetError("ONNX import: RNN cell clipping unsupported")
+    if node.op_type == "LSTM" and len(node.inputs) > 7 \
+            and node.inputs[7]:
+        raise MXNetError("ONNX import: LSTM peephole weights (input P) "
+                         "have no fused-RNN equivalent")
+    H = int(attrs["hidden_size"])
+    direction = attrs.get("direction", "forward")
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    if direction == "reverse":
+        raise MXNetError("ONNX import: reverse-only RNN direction")
+    bidir = direction == "bidirectional"
+    D = 2 if bidir else 1
+    if node.op_type == "GRU" and not attrs.get("linear_before_reset"):
+        raise MXNetError(
+            "ONNX import: GRU with linear_before_reset=0 (reset before "
+            "the recurrent matmul) has no fused-RNN equivalent")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise MXNetError("ONNX import: RNN sequence_lens")
+    if len(node.inputs) <= 5 or not node.inputs[5]:
+        raise MXNetError(
+            "ONNX import: RNN without initial_h — the fused RNN op "
+            "needs a state input (batch size is static in this "
+            "framework)")
+
+    W = ctx.const(node.inputs[1])  # (D, g*H, in)
+    R = ctx.const(node.inputs[2])  # (D, g*H, H)
+    B = (ctx.const(node.inputs[3])
+         if len(node.inputs) > 3 and node.inputs[3]
+         else np.zeros((D, 2 * n_gates * H), np.float32))
+    flat = []
+    for d in range(D):
+        flat.append(_unperm_gates(W[d], perm, H).ravel())
+        flat.append(_unperm_gates(R[d], perm, H).ravel())
+        gH = n_gates * H
+        flat.append(_unperm_gates(B[d][:gH, None], perm, H).ravel())
+        flat.append(_unperm_gates(B[d][gH:, None], perm, H).ravel())
+    pname = (node.name or node.outputs[0]) + "_rnn_params"
+    ctx.arg_params[pname] = ndarray.array(
+        np.concatenate(flat).astype("float32"))
+    ctx.tensors[pname] = sym_mod.var(pname)
+
+    rnn_ins = [ins[0], ctx.tensors[pname], ins[1]]  # data, params, h0
+    if node.op_type == "LSTM":
+        if len(ins) < 3:
+            raise MXNetError("ONNX import: LSTM without initial_c")
+        rnn_ins.append(ins[2])
+    want_states = any(node.outputs[1:])
+    out = sym_mod.RNN(*rnn_ins, state_size=H, num_layers=1, mode=mode,
+                      bidirectional=bidir, state_outputs=want_states)
+    # fused-op Y: (T, B, D*H) -> ONNX Y: (T, D, B, H)
+    y = out[0] if want_states else out
+    y_onnx = sym_mod.transpose(
+        sym_mod.Reshape(y, shape=(0, 0, D, H)), axes=(0, 2, 1, 3))
+    if not want_states:
+        return y_onnx
+    # index-for-index with the declared ONNX outputs [Y, Y_h(, Y_c)]:
+    # the fused op always yields the full state set when asked, so an
+    # omitted middle output ('') just stays unmapped
+    return [y_onnx] + [out[i] for i in range(1, len(node.outputs))]
+
+
 @_imp("Constant")
 def _constant(ctx, node, ins, attrs):
     t = attrs.get("value")
@@ -493,6 +589,7 @@ _CONST_SLOTS = {
     "Squeeze": (1,), "Unsqueeze": (1,), "Clip": (1, 2), "Pad": (1, 2),
     "Split": (1,), "Resize": (1, 2, 3), "Upsample": (1,),
     "ReduceSum": (1,), "Dropout": (1,),
+    "LSTM": (1, 2, 3, 4), "GRU": (1, 2, 3, 4), "RNN": (1, 2, 3, 4),
 }
 
 
@@ -539,7 +636,8 @@ def import_model(model_file):
             # unmapped; import only fails if something consumes them
             outs = [out]
         for name, o in zip(node.outputs, outs):
-            ctx.tensors[name] = o
+            if name:  # '' = omitted optional output slot
+                ctx.tensors[name] = o
 
     result = [ctx.sym(o.name) for o in graph.outputs]
     sym = result[0] if len(result) == 1 else sym_mod.Group(result)

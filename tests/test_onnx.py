@@ -187,3 +187,98 @@ def test_proto_foreign_producer_quirks():
     from mxnet_tpu.contrib.onnx._proto import Node
     node = Node.parse(bytes(n))
     assert node.attrs["ints"] == [3, 5]
+
+
+@pytest.mark.parametrize("mode,bidir", [
+    ("lstm", False), ("lstm", True), ("gru", False),
+    ("rnn_tanh", False), ("rnn_relu", True),
+])
+def test_roundtrip_rnn(mode, bidir, tmp_path):
+    """Fused RNN export->import forward parity: gates reordered to the
+    ONNX iofc/zrh conventions and back; Y layout round-trips through
+    the (T,D,B,H) ONNX form."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, B, I, H = 5, 3, 4, 6
+    D = 2 if bidir else 1
+    n = rnn_param_size(1, I, H, bidir, mode)
+    params = {"rnn_w": nd.array((RNG.rand(n) - 0.5) * 0.4)}
+    data = mx.sym.var("data")
+    h0 = mx.sym.var("h0")
+    args = [data, mx.sym.var("rnn_w"), h0]
+    shapes = [(T, B, I), (D, B, H)]
+    names = ["data", "h0"]
+    if mode == "lstm":
+        args.append(mx.sym.var("c0"))
+        shapes.append((D, B, H))
+        names.append("c0")
+    out = mx.sym.RNN(*args, state_size=H, num_layers=1, mode=mode,
+                     bidirectional=bidir)
+
+    feed = {nm: RNG.rand(*s).astype("float32")
+            for nm, s in zip(names, shapes)}
+    ex = out.bind(mx.cpu(), {**{k: nd.array(v) for k, v in feed.items()},
+                             "rnn_w": params["rnn_w"]})
+    ref = ex.forward(is_train=False)[0].asnumpy()
+    assert ref.shape == (T, B, D * H)
+
+    path = str(tmp_path / ("rnn_%s_%d.onnx" % (mode, D)))
+    export_model(out, params, shapes, onnx_file_path=path)
+    sym2, args2, aux2 = import_model(path)
+    ex2 = sym2.bind(mx.cpu(), {**{k: nd.array(v)
+                                  for k, v in feed.items()}, **args2},
+                    aux_states=aux2)
+    got = ex2.forward(is_train=False)[0].asnumpy()
+    assert got.shape == ref.shape
+    assert np.allclose(got, ref, atol=1e-4), np.abs(got - ref).max()
+
+
+def test_import_lstm_omitted_middle_output(tmp_path):
+    """Foreign LSTM declaring outputs ['Y', '', 'Y_c'] (Y_h omitted):
+    Y_c must bind to the CELL state, not slide into Y_h's slot."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, B, I, H = 4, 2, 3, 5
+    n = rnn_param_size(1, I, H, False, "lstm")
+    flat = (RNG.rand(n).astype("float32") - 0.5) * 0.4
+    # repack mx [i,f,g,o] -> onnx iofc W/R/B for the hand-built graph
+    gH = 4 * H
+    wi = flat[:gH * I].reshape(gH, I)
+    wh = flat[gH * I:gH * I + gH * H].reshape(gH, H)
+    bi = flat[gH * I + gH * H:gH * I + gH * H + gH]
+    bh = flat[gH * I + gH * H + gH:]
+    perm = (0, 3, 1, 2)
+
+    def po(mat):
+        blocks = [mat[g * H:(g + 1) * H] for g in range(4)]
+        return np.concatenate([blocks[g] for g in perm], axis=0)
+
+    g = P.Graph("lstm_ext")
+    g.initializers.append(P.Tensor("W", po(wi)[None]))
+    g.initializers.append(P.Tensor("R", po(wh)[None]))
+    g.initializers.append(P.Tensor(
+        "B", np.concatenate([po(bi[:, None]).ravel(),
+                             po(bh[:, None]).ravel()])[None]))
+    g.inputs.append(P.ValueInfo("x", P.FLOAT, [T, B, I]))
+    g.inputs.append(P.ValueInfo("h0", P.FLOAT, [1, B, H]))
+    g.inputs.append(P.ValueInfo("c0", P.FLOAT, [1, B, H]))
+    g.nodes.append(P.Node("LSTM", ["x", "W", "R", "B", "", "h0", "c0"],
+                          ["Y", "", "Yc"], "l1", {"hidden_size": H}))
+    g.outputs.append(P.ValueInfo("Yc", P.FLOAT, None))
+    path = str(tmp_path / "lstm_ext.onnx")
+    P.save(P.Model(g), path)
+
+    sym2, args2, aux2 = import_model(path)
+    feed = {"x": RNG.rand(T, B, I).astype("float32"),
+            "h0": np.zeros((1, B, H), "float32"),
+            "c0": np.zeros((1, B, H), "float32")}
+    ex = sym2.bind(mx.cpu(), {**{k: nd.array(v) for k, v in feed.items()},
+                              **args2}, aux_states=aux2)
+    got_c = ex.forward(is_train=False)[0].asnumpy()
+
+    # oracle: run the fused RNN directly and take the cell state
+    outs = mx.nd.RNN(nd.array(feed["x"]), nd.array(flat),
+                     nd.array(feed["h0"]), nd.array(feed["c0"]),
+                     state_size=H, num_layers=1, mode="lstm",
+                     state_outputs=True)
+    ref_c = outs[2].asnumpy()
+    assert got_c.shape == ref_c.shape
+    assert np.allclose(got_c, ref_c, atol=1e-5), np.abs(got_c - ref_c).max()
