@@ -1,0 +1,74 @@
+"""Python half of the C predict API (src/c_api.cc).
+
+Reference: amalgamation/c_predict_api.h — MXPredCreate loads a symbol
+JSON + .params file and binds a forward-only executor; SetInput /
+Forward / GetOutput drive it. The C shim (src/c_api.cc) embeds the
+interpreter and calls `create_predictor` here, keeping the C side to
+marshalling only.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "create_predictor"]
+
+
+class Predictor:
+    """A bound forward-only executor with byte-buffer I/O."""
+
+    def __init__(self, sym, arg_params, aux_params, shapes):
+        from . import context, ndarray
+        self._sym = sym
+        args = {}
+        for name in sym.list_arguments():
+            if name in shapes:
+                args[name] = ndarray.zeros(tuple(shapes[name]))
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                raise MXNetError(
+                    "predictor: argument %r has neither a declared "
+                    "input shape nor a loaded parameter" % name)
+        aux = {name: aux_params[name]
+               for name in sym.list_auxiliary_states()
+               if name in aux_params}
+        self._executor = sym.bind(context.cpu(), args, aux_states=aux,
+                                  grad_req="null")
+        self._inputs = {k: args[k] for k in shapes}
+
+    def set_input(self, key, buf):
+        """Copy a raw float32 byte buffer into input `key`."""
+        if key not in self._inputs:
+            raise MXNetError("predictor: unknown input %r (have %s)"
+                             % (key, sorted(self._inputs)))
+        arr = self._inputs[key]
+        data = np.frombuffer(buf, dtype=np.float32).reshape(arr.shape)
+        from .ndarray import array
+        new = array(data)
+        arr._data = new._data
+        return True
+
+    def forward(self):
+        return list(self._executor.forward(is_train=False))
+
+
+def create_predictor(symbol_json_path, params_path, shapes):
+    """MXPredCreate body: returns a Predictor (reference:
+    c_predict_api.h MXPredCreate semantics — .params entries use the
+    'arg:name'/'aux:name' prefixes)."""
+    from . import symbol as sym_mod
+    from . import ndarray
+    sym = sym_mod.load(symbol_json_path)
+    loaded = ndarray.load(params_path)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return Predictor(sym, arg_params, aux_params,
+                     {k: tuple(v) for k, v in shapes.items()})

@@ -1,0 +1,461 @@
+// C ABI over the TPU-native runtime (reference: include/mxnet/c_api.h
+// — the 189-function surface non-Python frontends attach to — and
+// amalgamation/c_predict_api.h, the deployment predict API).
+//
+// TPU-native redesign: the reference's C API fronts a C++ runtime; here
+// the runtime IS Python/JAX (SCOPE.md §2), so the C ABI embeds the
+// interpreter and drives it. The reference's breadth collapses the
+// same way the op registry did: NDArray handles + one generic
+// MXImperativeInvoke reach all ~374 registered ops, and the predict
+// API (load symbol JSON + params, set input, forward, read output)
+// covers the deployment path. A C/C++ host links -lmxtpu_capi (and
+// transitively libpython); when loaded INTO a Python process (ctypes
+// tests) the already-running interpreter is reused.
+//
+// Error handling: every call returns 0/-1 and MXGetLastError() gives
+// the message (reference c_api convention).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+// capture the current Python exception into g_last_error
+void set_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+std::once_flag g_init_once;
+bool g_we_initialized = false;
+
+// one-time interpreter bootstrap. MXTPU_HOME points at the repo root
+// (sys.path entry); MXTPU_CAPI_PLATFORM pins the jax platform BEFORE
+// first jax use (env JAX_PLATFORMS alone can lose the race against
+// sitecustomize-configured accelerators).
+bool ensure_python() {
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+    }
+  });
+  PyGILState_STATE st = PyGILState_Ensure();
+  static bool imported = false;
+  bool ok = true;
+  if (!imported) {
+    std::string boot = "import sys\n";
+    const char *home = getenv("MXTPU_HOME");
+    if (home) {
+      boot += std::string("sys.path.insert(0, '") + home + "')\n";
+    }
+    const char *plat = getenv("MXTPU_CAPI_PLATFORM");
+    if (plat) {
+      boot += std::string("import jax\n"
+                          "jax.config.update('jax_platforms', '") +
+              plat + "')\n";
+    }
+    boot += "import mxnet_tpu\n";
+    if (PyRun_SimpleString(boot.c_str()) != 0) {
+      set_error("failed to bootstrap mxnet_tpu (set MXTPU_HOME to the "
+                "repo root)");
+      ok = false;
+    } else {
+      imported = true;
+    }
+  }
+  PyGILState_Release(st);
+  return ok;
+}
+
+// a handle owns a PyObject* (NDArray) plus a cached shape buffer for
+// MXNDArrayGetShape's borrowed-pointer contract
+struct Handle {
+  PyObject *obj;
+  std::vector<int64_t> shape;
+};
+
+const char *kDtypeNames[] = {"float32", "float64", "float16",
+                             "uint8",   "int32",   "int8",
+                             "int64"};
+
+PyObject *mx_module() { return PyImport_ImportModule("mxnet_tpu"); }
+
+bool refresh_shape(Handle *h) {
+  PyObject *shp = PyObject_GetAttrString(h->obj, "shape");
+  if (!shp) return false;
+  Py_ssize_t n = PyTuple_Size(shp);
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape.push_back(PyLong_AsLongLong(PyTuple_GetItem(shp, i)));
+  }
+  Py_DECREF(shp);
+  return true;
+}
+
+// call mxnet_tpu.<path expr> with a tuple of args; returns new ref
+PyObject *call_expr(const char *expr, PyObject *args) {
+  PyObject *mx = mx_module();
+  if (!mx) return nullptr;
+  PyObject *main = PyImport_AddModule("__main__");  // borrowed
+  PyObject *globals = PyModule_GetDict(main);       // borrowed
+  PyDict_SetItemString(globals, "mxnet_tpu", mx);
+  PyObject *fn = PyRun_String(expr, Py_eval_input, globals, globals);
+  Py_DECREF(mx);
+  if (!fn) return nullptr;
+  PyObject *out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return out;
+}
+
+struct Predictor {
+  PyObject *executor;  // bound Executor
+  PyObject *outputs;   // list of output NDArrays after forward
+  std::vector<int64_t> out_shape;
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef void *NDArrayHandle;
+typedef void *PredictorHandle;
+
+int MXGetVersion(int *out) {
+  *out = 10500;  // tracks the reference 1.5 line
+  return 0;
+}
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype_flag,
+                    NDArrayHandle *out) {
+  if (!ensure_python()) return -1;
+  if (dtype_flag < 0 || dtype_flag > 6) {
+    set_error("bad dtype flag");
+    return -1;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject *args = Py_BuildValue("(Os)", shp, kDtypeNames[dtype_flag]);
+  Py_DECREF(shp);
+  PyObject *arr =
+      call_expr("lambda s, dt: mxnet_tpu.nd.zeros(s, dtype=dt)", args);
+  Py_DECREF(args);
+  if (arr) {
+    Handle *h = new Handle{arr, {}};
+    refresh_shape(h);
+    *out = h;
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (!handle) return 0;
+  PyGILState_STATE st = PyGILState_Ensure();
+  Handle *h = static_cast<Handle *>(handle);
+  Py_DECREF(h->obj);
+  delete h;
+  PyGILState_Release(st);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, int *out_dim,
+                      const int64_t **out_pdata) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Handle *h = static_cast<Handle *>(handle);
+  int rc = refresh_shape(h) ? 0 : (set_py_error(), -1);
+  *out_dim = static_cast<int>(h->shape.size());
+  *out_pdata = h->shape.data();
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Handle *h = static_cast<Handle *>(handle);
+  int rc = -1;
+  // route through numpy: frombuffer(bytes).reshape(shape) -> NDArray
+  PyObject *dt = PyObject_GetAttrString(h->obj, "dtype");
+  PyObject *args = Py_BuildValue("(Oy#O)", h->obj, (const char *)data,
+                                 (Py_ssize_t)size, dt);
+  Py_XDECREF(dt);
+  PyObject *res = call_expr(
+      "lambda a, buf, dt: a.__class__(__import__('numpy')"
+      ".frombuffer(buf, dtype=dt).reshape(a.shape))",
+      args);
+  Py_XDECREF(args);
+  if (res) {
+    // adopt the new array into the existing handle (reference
+    // SyncCopyFromCPU mutates in place)
+    PyObject *d = PyObject_GetAttrString(res, "_data");
+    if (d && PyObject_SetAttrString(h->obj, "_data", d) == 0) {
+      rc = 0;
+    } else {
+      set_py_error();
+    }
+    Py_XDECREF(d);
+    Py_DECREF(res);
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Handle *h = static_cast<Handle *>(handle);
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(O)", h->obj);
+  PyObject *b = call_expr("lambda a: a.asnumpy().tobytes()", args);
+  Py_XDECREF(args);
+  if (b) {
+    char *buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(b, &buf, &n) == 0 &&
+        static_cast<size_t>(n) <= size) {
+      std::memcpy(data, buf, n);
+      rc = 0;
+    } else {
+      set_error("output buffer too small");
+    }
+    Py_DECREF(b);
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+// The generic eager entry point: covers every registered op
+// (reference: MXImperativeInvoke, c_api.h — the path bindings use for
+// all operator calls).
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **keys, const char **vals) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<Handle *>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject *kw = PyDict_New();
+  for (int i = 0; i < num_params; ++i) {
+    PyObject *v = PyUnicode_FromString(vals[i]);
+    PyDict_SetItemString(kw, keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject *args = Py_BuildValue("(sOO)", op_name, ins, kw);
+  Py_DECREF(ins);
+  Py_DECREF(kw);
+  // params arrive as strings (C ABI convention); the registry's
+  // apply_defaults coerces via literal_eval-style parsing on the
+  // python side
+  PyObject *res = call_expr(
+      "lambda name, ins, kw: mxnet_tpu.ndarray.ndarray.invoke("
+      "mxnet_tpu.ops.registry.get(name), ins, "
+      "{k: (__import__('ast').literal_eval(v) if v and (v[0] in "
+      "'([{-0123456789' or v in ('True','False','None')) else v) "
+      "for k, v in kw.items()})",
+      args);
+  Py_XDECREF(args);
+  if (res) {
+    Py_ssize_t n = PyList_Size(res);
+    static thread_local std::vector<NDArrayHandle> out_handles;
+    out_handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *o = PyList_GetItem(res, i);  // borrowed
+      Py_INCREF(o);
+      Handle *h = new Handle{o, {}};
+      refresh_shape(h);
+      out_handles.push_back(h);
+    }
+    Py_DECREF(res);
+    *num_outputs = static_cast<int>(n);
+    *outputs = out_handles.data();
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+// ---------------------------------------------------------------------
+// predict API (reference: amalgamation/c_predict_api.h — the shape of
+// every C deployment of the reference)
+// ---------------------------------------------------------------------
+int MXPredCreate(const char *symbol_json_path, const char *params_path,
+                 int num_input_nodes, const char **input_keys,
+                 const int64_t **shapes, const int *ndims,
+                 PredictorHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shape_dict = PyDict_New();
+  for (int i = 0; i < num_input_nodes; ++i) {
+    PyObject *t = PyTuple_New(ndims[i]);
+    for (int j = 0; j < ndims[i]; ++j) {
+      PyTuple_SetItem(t, j, PyLong_FromLongLong(shapes[i][j]));
+    }
+    PyDict_SetItemString(shape_dict, input_keys[i], t);
+    Py_DECREF(t);
+  }
+  PyObject *args =
+      Py_BuildValue("(ssO)", symbol_json_path, params_path, shape_dict);
+  Py_DECREF(shape_dict);
+  // the real work lives in python (mxnet_tpu/c_predict.py): load
+  // symbol JSON + .params, simple_bind, expose set_input/forward
+  PyObject *helper = call_expr(
+      "lambda sj, pp, shapes: __import__('mxnet_tpu.c_predict', "
+      "fromlist=['c']).create_predictor(sj, pp, shapes)",
+      args);
+  Py_DECREF(args);
+  if (helper) {
+    Predictor *p = new Predictor{helper, nullptr, {}};
+    *out = p;
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, size_t n_floats) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Predictor *p = static_cast<Predictor *>(handle);
+  PyObject *args =
+      Py_BuildValue("(Osy#)", p->executor, key, (const char *)data,
+                    (Py_ssize_t)(n_floats * sizeof(float)));
+  PyObject *r = call_expr(
+      "lambda pred, key, buf: pred.set_input(key, buf)", args);
+  Py_XDECREF(args);
+  int rc = r ? 0 : (set_py_error(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Predictor *p = static_cast<Predictor *>(handle);
+  PyObject *args = Py_BuildValue("(O)", p->executor);
+  PyObject *r = call_expr("lambda pred: pred.forward()", args);
+  Py_XDECREF(args);
+  int rc = r ? 0 : (set_py_error(), -1);
+  Py_XDECREF(p->outputs);
+  p->outputs = r;  // list of output arrays
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, int index,
+                         const int64_t **out_shape, int *out_dim) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Predictor *p = static_cast<Predictor *>(handle);
+  int rc = -1;
+  if (p->outputs && index < PyList_Size(p->outputs)) {
+    PyObject *o = PyList_GetItem(p->outputs, index);
+    PyObject *shp = PyObject_GetAttrString(o, "shape");
+    if (shp) {
+      p->out_shape.clear();
+      for (Py_ssize_t i = 0; i < PyTuple_Size(shp); ++i) {
+        p->out_shape.push_back(
+            PyLong_AsLongLong(PyTuple_GetItem(shp, i)));
+      }
+      Py_DECREF(shp);
+      *out_shape = p->out_shape.data();
+      *out_dim = static_cast<int>(p->out_shape.size());
+      rc = 0;
+    } else {
+      set_py_error();
+    }
+  } else {
+    set_error("no outputs: call MXPredForward first / bad index");
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, int index, float *data,
+                    size_t n_floats) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Predictor *p = static_cast<Predictor *>(handle);
+  int rc = -1;
+  if (p->outputs && index < PyList_Size(p->outputs)) {
+    PyObject *o = PyList_GetItem(p->outputs, index);
+    PyObject *args = Py_BuildValue("(O)", o);
+    PyObject *b = call_expr(
+        "lambda a: a.asnumpy().astype('float32').tobytes()", args);
+    Py_XDECREF(args);
+    if (b) {
+      char *buf = nullptr;
+      Py_ssize_t n = 0;
+      if (PyBytes_AsStringAndSize(b, &buf, &n) == 0 &&
+          static_cast<size_t>(n) <= n_floats * sizeof(float)) {
+        std::memcpy(data, buf, n);
+        rc = 0;
+      } else {
+        set_error("output buffer too small");
+      }
+      Py_DECREF(b);
+    } else {
+      set_py_error();
+    }
+  } else {
+    set_error("no outputs: call MXPredForward first / bad index");
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  if (!handle) return 0;
+  PyGILState_STATE st = PyGILState_Ensure();
+  Predictor *p = static_cast<Predictor *>(handle);
+  Py_XDECREF(p->executor);
+  Py_XDECREF(p->outputs);
+  delete p;
+  PyGILState_Release(st);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,227 @@
+"""C ABI (src/c_api.cc): NDArray handles + MXImperativeInvoke + the
+predict API, exercised through ctypes (in-process interpreter) and a
+real compiled C host (embedded interpreter).
+
+Reference: include/mxnet/c_api.h (MXNDArray*/MXImperativeInvoke),
+amalgamation/c_predict_api.h (MXPred*). SCOPE.md §2 scopes non-Python
+frontends out; this is the attach surface a frontend WOULD use, kept
+to the generic core the reference's 189 functions decompose into.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "src", "libmxtpu_capi.so")
+
+
+def _build():
+    if not os.path.exists(SO):
+        subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                        "capi"], check=False,
+                       capture_output=True)
+    return os.path.exists(SO)
+
+
+pytestmark = pytest.mark.skipif(not _build(),
+                                reason="capi lib not buildable")
+
+
+def _lib():
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    # full argtypes: a bare int (e.g. outs[0]) would otherwise be
+    # passed as a truncated 32-bit c_int where a pointer is expected
+    lib.MXNDArrayCreate.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    lib.MXNDArrayGetShape.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXPredSetInput.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.MXPredForward.argtypes = [ctypes.c_void_p]
+    lib.MXPredGetOutputShape.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.MXPredGetOutput.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.MXPredFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def test_ndarray_roundtrip_and_invoke():
+    lib = _lib()
+    ver = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(ver)) == 0 and ver.value > 0
+
+    shape = (ctypes.c_int64 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h)) == 0, \
+        lib.MXGetLastError()
+
+    data = np.arange(6, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), data.nbytes) == 0, \
+        lib.MXGetLastError()
+
+    ndim = ctypes.c_int()
+    pdata = ctypes.POINTER(ctypes.c_int64)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(ndim.value)] == [2, 3]
+
+    # invoke a registered op through the generic C entry point
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(h)
+    keys = (ctypes.c_char_p * 1)(b"scalar")
+    vals = (ctypes.c_char_p * 1)(b"2.5")
+    assert lib.MXImperativeInvoke(
+        b"_mul_scalar", 1, ins, ctypes.byref(n_out),
+        ctypes.byref(outs), 1, keys, vals) == 0, lib.MXGetLastError()
+    assert n_out.value == 1
+
+    out_buf = np.empty(6, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        outs[0], out_buf.ctypes.data_as(ctypes.c_void_p),
+        out_buf.nbytes) == 0
+    assert np.allclose(out_buf, data * 2.5)
+    lib.MXNDArrayFree(outs[0])
+    lib.MXNDArrayFree(h)
+
+    # errors surface with a message, not a crash
+    bad = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 99, ctypes.byref(bad)) == -1
+    assert b"dtype" in lib.MXGetLastError()
+
+
+def test_predict_api(tmp_path):
+    # checkpoint a small net the reference way
+    data = mx.sym.var("data")
+    out = mx.sym.softmax(
+        mx.sym.FullyConnected(data, num_hidden=3, name="fc"))
+    rng = np.random.RandomState(0)
+    params = {"arg:fc_weight": nd.array(rng.rand(3, 4) - 0.5),
+              "arg:fc_bias": nd.zeros((3,))}
+    sym_path = str(tmp_path / "m-symbol.json")
+    par_path = str(tmp_path / "m-0000.params")
+    out.save(sym_path)
+    nd.save(par_path, params)
+
+    x = rng.rand(2, 4).astype("float32")
+    ref = None  # computed below via python for comparison
+    ex = out.bind(mx.cpu(), {"data": nd.array(x),
+                             "fc_weight": params["arg:fc_weight"],
+                             "fc_bias": params["arg:fc_bias"]})
+    ref = ex.forward(is_train=False)[0].asnumpy()
+
+    lib = _lib()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    shp = (ctypes.c_int64 * 2)(2, 4)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shp)
+    ndims = (ctypes.c_int * 1)(2)
+    pred = ctypes.c_void_p()
+    assert lib.MXPredCreate(sym_path.encode(), par_path.encode(), 1,
+                            keys, shapes, ndims,
+                            ctypes.byref(pred)) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXPredSetInput(
+        pred, b"data", x.ctypes.data_as(ctypes.c_void_p), x.size) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXPredForward(pred) == 0, lib.MXGetLastError()
+
+    oshape = ctypes.POINTER(ctypes.c_int64)()
+    odim = ctypes.c_int()
+    assert lib.MXPredGetOutputShape(pred, 0, ctypes.byref(oshape),
+                                    ctypes.byref(odim)) == 0
+    shape = tuple(oshape[i] for i in range(odim.value))
+    assert shape == (2, 3)
+    got = np.empty(shape, np.float32)
+    assert lib.MXPredGetOutput(
+        pred, 0, got.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        got.size) == 0
+    assert np.allclose(got, ref, atol=1e-5)
+    lib.MXPredFree(pred)
+
+
+C_HOST = r"""
+#include <stdio.h>
+#include <stdint.h>
+extern int MXGetVersion(int *);
+extern const char *MXGetLastError(void);
+extern int MXNDArrayCreate(const int64_t *, int, int, void **);
+extern int MXNDArraySyncCopyFromCPU(void *, const void *, size_t);
+extern int MXNDArraySyncCopyToCPU(void *, void *, size_t);
+extern int MXImperativeInvoke(const char *, int, void **, int *,
+                              void ***, int, const char **,
+                              const char **);
+int main(void) {
+  int64_t shape[1] = {4};
+  void *h;
+  if (MXNDArrayCreate(shape, 1, 0, &h)) {
+    fprintf(stderr, "create: %s\n", MXGetLastError());
+    return 1;
+  }
+  float xs[4] = {1, 2, 3, 4};
+  if (MXNDArraySyncCopyFromCPU(h, xs, sizeof xs)) return 2;
+  void **outs; int n_out;
+  const char *k[1] = {"scalar"}; const char *v[1] = {"10"};
+  if (MXImperativeInvoke("_plus_scalar", 1, &h, &n_out, &outs,
+                         1, k, v)) {
+    fprintf(stderr, "invoke: %s\n", MXGetLastError());
+    return 3;
+  }
+  float out[4];
+  if (MXNDArraySyncCopyToCPU(outs[0], out, sizeof out)) return 4;
+  if (out[0] != 11 || out[3] != 14) return 5;
+  printf("C_HOST_OK %g %g\n", out[0], out[3]);
+  return 0;
+}
+"""
+
+
+def test_embedded_c_host(tmp_path):
+    """A real C program links the ABI, embeds the interpreter, and runs
+    an op — the path a C++ frontend would take."""
+    src = tmp_path / "host.c"
+    src.write_text(C_HOST)
+    exe = str(tmp_path / "host")
+    r = subprocess.run(
+        ["gcc", str(src), "-o", exe, "-L" + os.path.join(REPO, "src"),
+         "-lmxtpu_capi", "-Wl,-rpath," + os.path.join(REPO, "src")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = REPO
+    env["MXTPU_CAPI_PLATFORM"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "C_HOST_OK 11 14" in r.stdout
