@@ -441,7 +441,8 @@ class ImageIter(DataIter):
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, aug_list=None, imglist=None,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 part_index=0, num_parts=1, **kwargs):
         super().__init__(batch_size)
         assert len(data_shape) == 3
         self.batch_size = batch_size
@@ -475,6 +476,12 @@ class ImageIter(DataIter):
         else:
             raise MXNetError(
                 "ImageIter needs path_imgrec, path_imglist or imglist")
+        # dataset sharding across workers (reference: ImageIter's
+        # part_index/num_parts): worker k keeps every n-th sample
+        if not 0 <= int(part_index) < int(num_parts):
+            raise MXNetError("part_index must be in [0, num_parts)")
+        if int(num_parts) > 1:
+            self.seq = self.seq[int(part_index)::int(num_parts)]
         if aug_list is None:
             aug_list = CreateAugmenter(data_shape, **{
                 k: v for k, v in kwargs.items()

@@ -368,7 +368,8 @@ class ImageDetIter(_img.ImageIter):
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root="", shuffle=False,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="label", **kwargs):
+                 label_name="label", part_index=0, num_parts=1,
+                 **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **{
                 k: v for k, v in kwargs.items()
@@ -383,7 +384,8 @@ class ImageDetIter(_img.ImageIter):
                          path_imgrec=path_imgrec,
                          path_imglist=path_imglist, path_root=path_root,
                          shuffle=shuffle, aug_list=[], imglist=imglist,
-                         data_name=data_name, label_name=label_name)
+                         data_name=data_name, label_name=label_name,
+                         part_index=part_index, num_parts=num_parts)
         self.det_auglist = aug_list
         max_objects, label_width = self._scan_label_shape()
         self.max_objects = max_objects

@@ -50,10 +50,18 @@ class ImageRecordIter(DataIter):
                  preprocess_threads=4, prefetch_buffer=4,
                  round_batch=True, data_name="data",
                  label_name="softmax_label", layout="NCHW",
-                 aug_list=None, dtype="float32", **kwargs):
+                 aug_list=None, dtype="float32", part_index=0,
+                 num_parts=1, **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (C, H, W)")
+        # dataset sharding across workers (reference: the kvstore-fed
+        # part_index/num_parts knobs of iter_image_recordio_2.cc):
+        # worker k keeps records with index ≡ k (mod n)
+        self._num_parts = int(num_parts)
+        self._part_index = int(part_index)
+        if not 0 <= self._part_index < self._num_parts:
+            raise MXNetError("part_index must be in [0, num_parts)")
         self._path = path_imgrec
         self._data_shape = tuple(int(s) for s in data_shape)
         self._label_width = int(label_width)
@@ -153,9 +161,15 @@ class ImageRecordIter(DataIter):
                     self._put(q, stop, self._collate(chunk, pad=0))
                 carry = samples
 
+            rec_idx = 0  # position in the FULL record stream
             for records in loader:
                 if stop.is_set():
                     return
+                if self._num_parts > 1:
+                    kept = [r for i, r in enumerate(records, rec_idx)
+                            if i % self._num_parts == self._part_index]
+                    rec_idx += len(records)
+                    records = kept
                 if self._shuffle:
                     buf.extend(records)
                     if len(buf) >= self._shuffle_chunk:

@@ -195,3 +195,46 @@ def test_shuffle_mixes_across_batches(rec_path):
     # file order would give exactly labels [0,1,2,3] in the first batch
     assert labels != [0.0, 1.0, 2.0, 3.0], \
         "first batch membership identical to file order"
+
+
+def test_part_index_sharding(tmp_path):
+    """part_index/num_parts split the record stream disjointly and
+    exhaustively across workers (reference: iter_image_recordio_2.cc
+    partition knobs; ImageIter's list sharding)."""
+    import numpy as np
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io_record import ImageRecordIter
+
+    path = str(tmp_path / "shard")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(0)
+    n = 24
+    for i in range(n):
+        img = rng.randint(0, 255, (8, 8, 3), np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    rec.close()
+
+    def labels_of(part, parts):
+        it = ImageRecordIter(path + ".rec", data_shape=(3, 8, 8),
+                             batch_size=4, preprocess_threads=1,
+                             part_index=part, num_parts=parts,
+                             round_batch=False)
+        out = []
+        for b in it:
+            out.extend(b.label[0].asnumpy().astype(int).tolist())
+        it.close()
+        return out
+
+    a = labels_of(0, 2)
+    b = labels_of(1, 2)
+    assert sorted(a + b) == list(range(n))   # disjoint + exhaustive
+    assert set(a) & set(b) == set()
+    assert all(x % 2 == 0 for x in a) and all(x % 2 == 1 for x in b)
+
+    # the image-list iterator shards its sequence the same way
+    import mxnet_tpu as mx
+    it0 = mx.image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                             path_imgrec=path + ".rec",
+                             part_index=0, num_parts=3)
+    assert len(it0.seq) == 8
