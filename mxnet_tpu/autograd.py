@@ -91,9 +91,11 @@ def predict_mode():
 
 
 class _TapeNode:
-    __slots__ = ("op", "inputs", "vjp_fn", "n_raw", "visible", "out_avals")
+    __slots__ = ("op", "inputs", "vjp_fn", "n_raw", "visible", "out_avals",
+                 "replay", "in_arrays", "rng_key")
 
-    def __init__(self, op, inputs, vjp_fn, n_raw, visible, out_avals=()):
+    def __init__(self, op, inputs, vjp_fn, n_raw, visible, out_avals=(),
+                 replay=None, in_arrays=None, rng_key=None):
         self.op = op
         self.inputs = inputs      # list of NDArray (strong refs)
         self.vjp_fn = vjp_fn
@@ -102,12 +104,21 @@ class _TapeNode:
         # (shape, dtype) per raw output — needed to zero-fill cotangent
         # slots of unused outputs (vjp wants the full output pytree)
         self.out_avals = out_avals
+        # pure forward closure + its record-time input arrays: lets
+        # grad(create_graph=True) replay the subgraph as a pure JAX
+        # function, so higher-order derivatives compose through jax.vjp
+        # instead of needing a tape-of-tapes.
+        self.replay = replay
+        self.in_arrays = in_arrays
+        self.rng_key = rng_key    # key consumed at record time, for replay
 
 
-def _record(op, inputs, outputs, raw, vjp_fn):
+def _record(op, inputs, outputs, raw, vjp_fn, replay=None, in_arrays=None,
+            rng_key=None):
     """Called by ndarray.invoke under record scope."""
     node = _TapeNode(op, list(inputs), vjp_fn, len(raw), len(outputs),
-                     out_avals=[(r.shape, r.dtype) for r in raw])
+                     out_avals=[(r.shape, r.dtype) for r in raw],
+                     replay=replay, in_arrays=in_arrays, rng_key=rng_key)
     for i, out in enumerate(outputs):
         out._tape_node = node
         out._tape_index = i
@@ -227,40 +238,205 @@ def _walk(heads, head_grads, retain_graph, collect_for=None):
     return None
 
 
+def _normalize_head_grads(heads, head_grads):
+    """Shared output-cotangent seeding: ones for None, unwrap NDArrays."""
+    if head_grads is None:
+        return [jnp.ones_like(h._data) for h in heads]
+    if not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    return [jnp.ones_like(h._data) if g is None else g._data
+            for h, g in zip(heads, head_grads)]
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Compute gradients of heads w.r.t. all marked variables
     (reference: autograd.py:243)."""
     from .ndarray.ndarray import NDArray
     if isinstance(heads, NDArray):
         heads = [heads]
-    if head_grads is None:
-        head_grads = [jnp.ones_like(h._data) for h in heads]
-    else:
-        if not isinstance(head_grads, (list, tuple)):
-            head_grads = [head_grads]
-        head_grads = [jnp.ones_like(h._data) if g is None else g._data
-                      for h, g in zip(heads, head_grads)]
-    _walk(heads, head_grads, retain_graph)
+    _walk(heads, _normalize_head_grads(heads, head_grads), retain_graph)
+
+
+def _build_head_fn(heads, variables):
+    """Reconstruct the recorded subgraph between `variables` and `heads` as a
+    pure function var_arrays -> tuple(head_arrays).
+
+    This is the TPU-native path to higher-order autograd: rather than taping
+    the backward pass (the reference's NNVM approach, autograd.py:270 /
+    imperative.cc:270), we replay the forward as a traceable JAX function and
+    let jax.vjp compose to any derivative order.
+
+    Only the variable-dependent subgraph is replayed; branches constant
+    w.r.t. the variables fold to their record-time values (so constant
+    branches may contain non-replayable nodes, e.g. custom Functions).
+    Returns (head_fn, recorded_var_vals) where recorded_var_vals maps each
+    reachable variable to its record-time value; a variable absent from it
+    is unreachable from the heads.
+    """
+    from .ndarray.ndarray import NDArray
+
+    var_ids = {id(v): v for v in variables}
+    full_order, seen = [], set()
+
+    def dfs(nd):
+        node = nd._tape_node
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs:
+            if isinstance(inp, NDArray) and id(inp) not in var_ids:
+                dfs(inp)
+        full_order.append(node)
+
+    for h in heads:
+        if id(h) not in var_ids:
+            dfs(h)
+
+    # variable-dependence analysis: only dependent nodes are replayed;
+    # everything else folds to its recorded value
+    dependent = set()
+    recorded_var_vals = {}
+    for node in full_order:
+        for j, inp in enumerate(node.inputs):
+            if not isinstance(inp, NDArray):
+                continue
+            if id(inp) in var_ids:
+                dependent.add(id(node))
+                # the value this consumer saw at record time — later in-place
+                # mutation of the variable must not change the answer
+                val = (node.in_arrays[j] if node.in_arrays is not None
+                       else inp._data)
+                prev = recorded_var_vals.setdefault(id(inp), val)
+                # identity check: a variable rebound between two recorded
+                # uses has no single replay value — refuse rather than
+                # silently differentiate at the first-seen one
+                if prev is not val:
+                    raise MXNetError(
+                        "autograd.grad(create_graph=True): variable was "
+                        "mutated in place between recorded uses; the "
+                        "replayed graph has no consistent value for it")
+            elif inp._tape_node is not None and \
+                    id(inp._tape_node) in dependent:
+                dependent.add(id(node))
+    order = [n for n in full_order if id(n) in dependent]
+
+    for node in order:
+        if node.replay is None:
+            raise MXNetError(
+                "autograd.grad(create_graph=True): the variable-dependent "
+                "subgraph contains a node ('%s') that cannot be replayed "
+                "(custom autograd.Function and subgraph control-flow ops "
+                "record opaque backward closures). Higher-order gradients "
+                "require pure-JAX replayable ops on the path from the "
+                "variables to the heads." % getattr(node.op, "name", "?"))
+
+    for h in heads:  # a head that IS a variable depends on it trivially
+        if id(h) in var_ids:
+            recorded_var_vals.setdefault(id(h), h._data)
+
+    def head_fn(*var_vals):
+        env = {id(v): val for v, val in zip(variables, var_vals)}
+        node_out = {}
+
+        def in_val(node, j, inp):
+            if isinstance(inp, NDArray):
+                if id(inp) in env:
+                    return env[id(inp)]
+                n2 = inp._tape_node
+                if n2 is not None and id(n2) in node_out:
+                    return node_out[id(n2)][inp._tape_index]
+            # constant w.r.t. the variables: value captured at record time
+            return node.in_arrays[j]
+
+        for node in order:
+            arrs = [in_val(node, j, inp) for j, inp in enumerate(node.inputs)]
+            if node.rng_key is not None:
+                arrs = [node.rng_key] + arrs
+            out = node.replay(*arrs)
+            node_out[id(node)] = out if isinstance(out, tuple) else (out,)
+
+        outs = []
+        for h in heads:
+            if id(h) in env:
+                outs.append(env[id(h)])
+            elif h._tape_node is not None and id(h._tape_node) in node_out:
+                outs.append(node_out[id(h._tape_node)][h._tape_index])
+            else:
+                outs.append(h._data)
+        return tuple(outs)
+
+    return head_fn, recorded_var_vals
+
+
+class _GradOp:
+    needs_rng = False
+    name = "_autograd_grad"
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """grad() with create_graph=True: differentiable gradients.
+
+    Computes d(heads)/d(variables) via jax.vjp over the replayed forward and
+    records the result on the tape (with a replayable closure of its own), so
+    backward()/grad() over the returned gradients — at any order — just work.
+    """
+    from .ndarray.ndarray import NDArray
+
+    # dedupe: a variable listed twice gets the same (full) gradient in every
+    # position, matching the tape path's collect_for semantics
+    uniq, pos = [], []
+    index_of = {}
+    for v in variables:
+        if id(v) not in index_of:
+            index_of[id(v)] = len(uniq)
+            uniq.append(v)
+        pos.append(index_of[id(v)])
+
+    head_fn, recorded_vals = _build_head_fn(heads, uniq)
+    for v in uniq:
+        if id(v) not in recorded_vals:
+            raise MXNetError("autograd.grad: a variable is unreachable "
+                             "from the heads")
+    var_vals = tuple(recorded_vals[id(v)] for v in uniq)
+    hg = tuple(head_grads)
+
+    def grad_fn(*vals):
+        _, pull = jax.vjp(head_fn, *vals)
+        return tuple(pull(hg))
+
+    out_vals, pullback = jax.vjp(grad_fn, *var_vals)
+    node = _TapeNode(_GradOp(), list(uniq),
+                     lambda cots: pullback(tuple(cots)),
+                     len(out_vals), len(out_vals),
+                     out_avals=[(o.shape, o.dtype) for o in out_vals],
+                     replay=grad_fn, in_arrays=list(var_vals))
+    outs = []
+    for i in pos:
+        o = NDArray(out_vals[i], uniq[i]._ctx)
+        o._tape_node = node
+        o._tape_index = i
+        outs.append(o)
+    return outs
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Return grads of heads w.r.t. variables (reference: autograd.py:270).
-    create_graph (higher-order) is not supported yet."""
+
+    With create_graph=True the returned gradients are themselves recorded on
+    the tape, so they can be differentiated again (higher-order autograd)."""
     from .ndarray.ndarray import NDArray
-    if create_graph:
-        raise MXNetError("autograd.grad: create_graph=True not supported yet")
     if isinstance(heads, NDArray):
         heads = [heads]
     if isinstance(variables, NDArray):
         variables = [variables]
+    if create_graph:
+        return _grad_create_graph(heads, variables,
+                                  _normalize_head_grads(heads, head_grads))
     if retain_graph is None:
         retain_graph = create_graph
-    if head_grads is None:
-        head_grads = [jnp.ones_like(h._data) for h in heads]
-    else:
-        head_grads = [g._data for g in head_grads]
-    gs = _walk(heads, head_grads, retain_graph, collect_for=variables)
+    gs = _walk(heads, _normalize_head_grads(heads, head_grads), retain_graph,
+               collect_for=variables)
     out = []
     for v, g in zip(variables, gs):
         if g is None:

@@ -567,7 +567,10 @@ def invoke(op, inputs, params, name=None):
                 tgt._data = raw[out_idx]
 
     if recording:
-        autograd._record(op, inputs, outputs, raw, vjp_fn)
+        rng_key = arrays[0] if op.needs_rng else None
+        in_arrays = arrays[1:] if op.needs_rng else arrays
+        autograd._record(op, inputs, outputs, raw, vjp_fn,
+                         replay=fn, in_arrays=in_arrays, rng_key=rng_key)
     if prof_t0 is not None:
         _prof.record_op(op.name, prof_t0, time.perf_counter())
     from .. import engine as _engine

@@ -187,3 +187,227 @@ def test_numeric_vs_autograd():
             xm = xv.copy(); xm[i, j] -= eps
             num[i, j] = (f_np(xp) - f_np(xm)) / (2 * eps)
     assert np.allclose(x.grad.asnumpy(), num, atol=1e-2, rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# higher-order autograd (reference: python/mxnet/autograd.py:270 grad() with
+# create_graph=True; tests/python/unittest/test_autograd.py grad_and_loss)
+# ---------------------------------------------------------------------------
+
+
+def test_second_order_polynomial():
+    # y = x^3  =>  dy/dx = 3x^2,  d2y/dx2 = 6x
+    x = nd.array([1.0, 2.0, -3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (dx,) = autograd.grad(y, [x], create_graph=True)
+        assert np.allclose(dx.asnumpy(), 3 * x.asnumpy() ** 2)
+        (d2x,) = autograd.grad(dx, [x])
+    assert np.allclose(d2x.asnumpy(), 6 * x.asnumpy())
+
+
+def test_second_order_sin():
+    xv = np.linspace(-2, 2, 9).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        (dx,) = autograd.grad(y, [x], create_graph=True)
+        (d2x,) = autograd.grad(dx, [x])
+    assert np.allclose(dx.asnumpy(), np.cos(xv), atol=1e-5)
+    assert np.allclose(d2x.asnumpy(), -np.sin(xv), atol=1e-5)
+
+
+def test_third_order():
+    # y = x^4 => y''' = 24x
+    x = nd.array([0.5, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 4
+        (g1,) = autograd.grad(y, [x], create_graph=True)
+        (g2,) = autograd.grad(g1, [x], create_graph=True)
+        (g3,) = autograd.grad(g2, [x])
+    assert np.allclose(g1.asnumpy(), 4 * x.asnumpy() ** 3, atol=1e-4)
+    assert np.allclose(g2.asnumpy(), 12 * x.asnumpy() ** 2, atol=1e-4)
+    assert np.allclose(g3.asnumpy(), 24 * x.asnumpy(), atol=1e-4)
+
+
+def test_second_order_backward_into_grad_buffers():
+    # grad-of-grad via .backward() on the first-order grads
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+        (dx,) = autograd.grad(y, [x], create_graph=True)
+        z = dx * dx        # z = ((x+1)e^x)^2 ; dz/dx = 2(x+1)e^x (x+2)e^x
+        z.backward()
+    e = np.exp(2.0)
+    expect = 2 * (3 * e) * (4 * e)
+    assert np.allclose(x.grad.asnumpy(), [expect], rtol=1e-5)
+
+
+def test_second_order_fc_chain():
+    # Hessian-vector-style check on a small dense network via finite diff
+    rng = np.random.RandomState(3)
+    wv = rng.randn(4, 4).astype(np.float32) * 0.3
+    xv = rng.randn(2, 4).astype(np.float32)
+
+    def loss_grad_np(w):
+        # f = sum(tanh(x @ w)^2); df/dw via numeric diff of f
+        eps = 1e-3
+        g = np.zeros_like(w)
+        def f(w):
+            return float((np.tanh(xv @ w) ** 2).sum())
+        for i in range(w.shape[0]):
+            for j in range(w.shape[1]):
+                wp = w.copy(); wp[i, j] += eps
+                wm = w.copy(); wm[i, j] -= eps
+                g[i, j] = (f(wp) - f(wm)) / (2 * eps)
+        return g
+
+    w = nd.array(wv)
+    w.attach_grad()
+    x = nd.array(xv)
+    with autograd.record():
+        h = nd.tanh(nd.dot(x, w))
+        loss = nd.sum(h * h)
+        (dw,) = autograd.grad(loss, [w], create_graph=True)
+        # second-order: d(sum(dw^2))/dw, checked against finite diff of dw
+        s = nd.sum(dw * dw)
+        (d2,) = autograd.grad(s, [w])
+    assert np.allclose(dw.asnumpy(), loss_grad_np(wv), atol=5e-2, rtol=5e-2)
+    eps = 1e-2
+    num = np.zeros_like(wv)
+    def s_np(w):
+        return float((loss_grad_np(w) ** 2).sum())
+    for i in range(2):           # spot-check a few entries (numeric 2nd order)
+        for j in range(2):
+            wp = wv.copy(); wp[i, j] += eps
+            wm = wv.copy(); wm[i, j] -= eps
+            num[i, j] = (s_np(wp) - s_np(wm)) / (2 * eps)
+    assert np.allclose(d2.asnumpy()[:2, :2], num[:2, :2], atol=0.1, rtol=0.1)
+
+
+def test_create_graph_rejects_custom_function():
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    f = Double()
+    with autograd.record():
+        y = f(x)
+        try:
+            autograd.grad(y, [x], create_graph=True)
+            assert False, "expected MXNetError"
+        except Exception as e:
+            assert "replay" in str(e)
+
+
+def test_second_order_conv():
+    rng = np.random.RandomState(7)
+    xv = rng.randn(1, 4, 4, 2).astype(np.float32)  # NHWC
+    wv = rng.randn(2, 3, 3, 2).astype(np.float32) * 0.2  # (O, kH, kW, I)
+    x = nd.array(xv)
+    w = nd.array(wv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w, nd.zeros((2,)), kernel=(3, 3), num_filter=2,
+                           layout="NHWC")
+        loss = nd.sum(y * y)
+        (dx,) = autograd.grad(loss, [x], create_graph=True)
+        s = nd.sum(dx * dx)
+        (d2,) = autograd.grad(s, [x])
+    # loss is quadratic in x so s = sum(dx^2) is quartic; check d2 = ds/dx
+    # against central differences of s computed purely numerically
+    eps = 1e-2
+    def s_np(xin):
+        def loss_of(xa):
+            yv = nd.Convolution(nd.array(xa), w, nd.zeros((2,)), kernel=(3, 3),
+                                num_filter=2, layout="NHWC")
+            return float((yv.asnumpy() ** 2).sum())
+        g = np.zeros_like(xin)
+        it = np.nditer(xin, flags=["multi_index"])
+        for _ in it:
+            idx = it.multi_index
+            xp = xin.copy(); xp[idx] += eps
+            xm = xin.copy(); xm[idx] -= eps
+            g[idx] = (loss_of(xp) - loss_of(xm)) / (2 * eps)
+        return float((g ** 2).sum())
+    d2n = d2.asnumpy()
+    for idx in [(0, 0, 0, 0), (0, 1, 2, 1), (0, 3, 3, 0)]:
+        xp = xv.copy(); xp[idx] += eps
+        xm = xv.copy(); xm[idx] -= eps
+        numv = (s_np(xp) - s_np(xm)) / (2 * eps)
+        assert np.allclose(d2n[idx], numv, rtol=0.15, atol=0.5), (idx, d2n[idx], numv)
+
+
+def test_create_graph_uses_record_time_values():
+    # in-place mutation between record and grad() must not change the answer
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x += 1.0  # rebinds x._data; the tape saw 2.0
+    (g,) = autograd.grad(y, [x], create_graph=True)
+    assert np.allclose(g.asnumpy(), [4.0])
+
+
+def test_create_graph_unreachable_raises():
+    x = nd.array([1.0])
+    w = nd.array([1.0])
+    x.attach_grad(); w.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            autograd.grad(y, [w], create_graph=True)
+
+
+def test_create_graph_duplicate_variable():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g1, g2 = autograd.grad(y, [x, x], create_graph=True)
+    assert np.allclose(g1.asnumpy(), [6.0])
+    assert np.allclose(g2.asnumpy(), [6.0])
+
+
+def test_create_graph_constant_function_branch_folds():
+    # a custom Function on a branch constant w.r.t. the variable is folded to
+    # its recorded value rather than rejected
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    k = nd.array([5.0])
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        c = Double()(k)          # constant branch
+        y = x * x + c
+        (dx,) = autograd.grad(y, [x], create_graph=True)
+        (d2x,) = autograd.grad(dx, [x])
+    assert np.allclose(dx.asnumpy(), [4.0])
+    assert np.allclose(d2x.asnumpy(), [2.0])
+
+
+def test_create_graph_rejects_mutated_between_uses():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * x
+        with autograd.pause():
+            x += 1.0
+        b = x * x
+        y = a + b
+        with pytest.raises(mx.MXNetError):
+            autograd.grad(y, [x], create_graph=True)
