@@ -175,18 +175,28 @@ def _walk(heads, head_grads, retain_graph, collect_for=None):
                 "(was it computed under autograd.record()?)")
         seed(nd, g)
 
-    # topo order over nodes reachable from heads
+    # topo order over nodes reachable from heads (iterative: recorded
+    # chains can exceed Python's recursion limit)
     order = []
     seen = set()
 
-    def dfs(node):
-        if node is None or id(node) in seen:
+    def dfs(root):
+        if root is None or id(root) in seen:
             return
-        seen.add(id(node))
-        for inp in node.inputs:
-            if isinstance(inp, NDArray):
-                dfs(inp._tape_node)
-        order.append(node)
+        stack = [(root, False)]
+        while stack:
+            n, expanded = stack.pop()
+            if expanded:
+                order.append(n)
+                continue
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.append((n, True))
+            for inp in n.inputs:
+                if isinstance(inp, NDArray) and inp._tape_node is not None \
+                        and id(inp._tape_node) not in seen:
+                    stack.append((inp._tape_node, False))
 
     for nd in heads:
         dfs(nd._tape_node)
@@ -269,24 +279,42 @@ def _build_head_fn(heads, variables):
     Only the variable-dependent subgraph is replayed; branches constant
     w.r.t. the variables fold to their record-time values (so constant
     branches may contain non-replayable nodes, e.g. custom Functions).
-    Returns (head_fn, recorded_var_vals) where recorded_var_vals maps each
-    reachable variable to its record-time value; a variable absent from it
-    is unreachable from the heads.
+    Returns (head_fn, recorded_var_vals, extras):
+      - recorded_var_vals maps each reachable variable to its record-time
+        value; a variable absent from it is unreachable from the heads;
+      - extras is a list of (ndarray, recorded_value) for every OTHER
+        differentiable leaf the replayed subgraph reads (weights, inputs,
+        tape intermediates). head_fn takes var_vals + extra_vals, so the
+        recorded gradient keeps cotangent paths into those leaves — e.g.
+        the WGAN-GP pattern (penalty = |dL/dx|²) must still backprop into
+        the weights, which are extras here, not listed variables.
     """
     from .ndarray.ndarray import NDArray
 
     var_ids = {id(v): v for v in variables}
     full_order, seen = [], set()
 
-    def dfs(nd):
-        node = nd._tape_node
+    # iterative post-order DFS: recorded chains can be 1000s of ops deep
+    # (unrolled RNNs), past Python's recursion limit
+    def dfs(root):
+        node = root._tape_node
         if node is None or id(node) in seen:
             return
-        seen.add(id(node))
-        for inp in node.inputs:
-            if isinstance(inp, NDArray) and id(inp) not in var_ids:
-                dfs(inp)
-        full_order.append(node)
+        stack = [(node, False)]
+        while stack:
+            n, expanded = stack.pop()
+            if expanded:
+                full_order.append(n)
+                continue
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.append((n, True))
+            for inp in n.inputs:
+                if isinstance(inp, NDArray) and id(inp) not in var_ids:
+                    n2 = inp._tape_node
+                    if n2 is not None and id(n2) not in seen:
+                        stack.append((n2, False))
 
     for h in heads:
         if id(h) not in var_ids:
@@ -330,12 +358,38 @@ def _build_head_fn(heads, variables):
                 "require pure-JAX replayable ops on the path from the "
                 "variables to the heads." % getattr(node.op, "name", "?"))
 
+    # other differentiable leaves read by the replayed subgraph: an
+    # NDArray input with a grad buffer, or produced by a NON-replayed
+    # (variable-independent) tape node, must stay a function argument
+    # (not a folded constant) so later backward()/grad() over the
+    # returned gradients can reach it. Intermediates produced by
+    # replayed nodes are recomputed, never arguments.
+    extras, extra_seen = [], set()
+    for node in order:
+        for j, inp in enumerate(node.inputs):
+            if (not isinstance(inp, NDArray) or id(inp) in var_ids
+                    or id(inp) in extra_seen):
+                continue
+            produced_by_replay = (inp._tape_node is not None
+                                  and id(inp._tape_node) in dependent)
+            if produced_by_replay:
+                continue
+            if inp._tape_node is not None or inp._grad is not None:
+                extra_seen.add(id(inp))
+                val = (node.in_arrays[j] if node.in_arrays is not None
+                       else inp._data)
+                extras.append((inp, val))
+
     for h in heads:  # a head that IS a variable depends on it trivially
         if id(h) in var_ids:
             recorded_var_vals.setdefault(id(h), h._data)
 
-    def head_fn(*var_vals):
-        env = {id(v): val for v, val in zip(variables, var_vals)}
+    n_vars = len(variables)
+
+    def head_fn(*vals):
+        env = {id(v): val for v, val in zip(variables, vals[:n_vars])}
+        for (leaf, _), val in zip(extras, vals[n_vars:]):
+            env[id(leaf)] = val
         node_out = {}
 
         def in_val(node, j, inp):
@@ -365,7 +419,7 @@ def _build_head_fn(heads, variables):
                 outs.append(h._data)
         return tuple(outs)
 
-    return head_fn, recorded_var_vals
+    return head_fn, recorded_var_vals, extras
 
 
 class _GradOp:
@@ -392,24 +446,29 @@ def _grad_create_graph(heads, variables, head_grads):
             uniq.append(v)
         pos.append(index_of[id(v)])
 
-    head_fn, recorded_vals = _build_head_fn(heads, uniq)
+    head_fn, recorded_vals, extras = _build_head_fn(heads, uniq)
     for v in uniq:
         if id(v) not in recorded_vals:
             raise MXNetError("autograd.grad: a variable is unreachable "
                              "from the heads")
-    var_vals = tuple(recorded_vals[id(v)] for v in uniq)
+    n_vars = len(uniq)
+    all_inputs = list(uniq) + [leaf for leaf, _ in extras]
+    all_vals = tuple([recorded_vals[id(v)] for v in uniq]
+                     + [val for _, val in extras])
     hg = tuple(head_grads)
 
     def grad_fn(*vals):
+        # gradients w.r.t. the listed variables only, but as a function of
+        # ALL differentiable leaves so their cotangent paths survive
         _, pull = jax.vjp(head_fn, *vals)
-        return tuple(pull(hg))
+        return tuple(pull(hg)[:n_vars])
 
-    out_vals, pullback = jax.vjp(grad_fn, *var_vals)
-    node = _TapeNode(_GradOp(), list(uniq),
+    out_vals, pullback = jax.vjp(grad_fn, *all_vals)
+    node = _TapeNode(_GradOp(), all_inputs,
                      lambda cots: pullback(tuple(cots)),
                      len(out_vals), len(out_vals),
                      out_avals=[(o.shape, o.dtype) for o in out_vals],
-                     replay=grad_fn, in_arrays=list(var_vals))
+                     replay=grad_fn, in_arrays=list(all_vals))
     outs = []
     for i in pos:
         o = NDArray(out_vals[i], uniq[i]._ctx)

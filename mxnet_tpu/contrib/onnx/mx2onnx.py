@@ -240,10 +240,29 @@ def _dropout(ctx, node, ins, outs, p):
     ctx.emit("Dropout", ins + [ratio], outs, node.name)
 
 
-@_handler("softmax", "SoftmaxActivation")
+@_handler("softmax")
 def _softmax(ctx, node, ins, outs, p):
     ctx.emit("Softmax", ins, outs, node.name,
              axis=int(p.get("axis", -1)))
+
+
+@_handler("SoftmaxActivation")
+def _softmax_activation(ctx, node, ins, outs, p):
+    # SoftmaxActivation has no axis param (nn/softmax_activation-inl.h):
+    # mode='channel' normalizes over axis 1; default mode='instance'
+    # over the flattened non-batch dims.
+    if p.get("mode", "instance") == "channel":
+        ctx.emit("Softmax", ins, outs, node.name, axis=1)
+        return
+    # instance mode: Flatten to (N, prod(rest)), softmax the rows, then
+    # restore the original shape via a runtime Shape of the input
+    flat = node.name + "_flat"
+    sm = node.name + "_sm"
+    shp = node.name + "_shape"
+    ctx.emit("Flatten", ins, [flat], node.name + "_flatten", axis=1)
+    ctx.emit("Softmax", [flat], [sm], node.name, axis=-1)
+    ctx.emit("Shape", ins, [shp], node.name + "_shapeof")
+    ctx.emit("Reshape", [sm, shp], outs, node.name + "_reshape")
 
 
 @_handler("SoftmaxOutput")

@@ -66,10 +66,20 @@ def _pads2mx(attrs, nd_):
     return tuple(begin)
 
 
+def _weight_param(ctx, node, op):
+    """num_filter/num_hidden come from the weight initializer's shape; a
+    weight produced by another node (valid ONNX) has no static shape here."""
+    wname = node.inputs[1]
+    if wname not in ctx.arg_params:
+        raise MXNetError("ONNX import: %s weight must be an initializer "
+                         "(got graph input or node output %r)" % (op, wname))
+    return ctx.arg_params[wname]
+
+
 @_imp("Conv")
 def _conv(ctx, node, ins, attrs):
     k = tuple(int(x) for x in attrs["kernel_shape"])
-    w = ctx.arg_params[node.inputs[1]]
+    w = _weight_param(ctx, node, "Conv")
     return sym_mod.Convolution(
         *ins, kernel=k, num_filter=int(w.shape[0]),
         stride=tuple(attrs.get("strides", (1,) * len(k))),
@@ -82,7 +92,7 @@ def _conv(ctx, node, ins, attrs):
 @_imp("ConvTranspose")
 def _deconv(ctx, node, ins, attrs):
     k = tuple(int(x) for x in attrs["kernel_shape"])
-    w = ctx.arg_params[node.inputs[1]]
+    w = _weight_param(ctx, node, "ConvTranspose")
     kw = {}
     if attrs.get("output_padding"):
         kw["adj"] = tuple(attrs["output_padding"])
@@ -102,16 +112,12 @@ def _gemm(ctx, node, ins, attrs):
     beta = float(attrs.get("beta", 1.0))
     if int(attrs.get("transA", 0)):
         raise MXNetError("ONNX import: Gemm(transA=1)")
-    wname = node.inputs[1]
-    if wname not in ctx.arg_params:
-        raise MXNetError("ONNX import: Gemm weight must be an "
-                         "initializer")
-    w = ctx.arg_params[wname].asnumpy()
+    w = _weight_param(ctx, node, "Gemm").asnumpy()
     if not int(attrs.get("transB", 0)):
         w = w.T  # FullyConnected stores (out, in)
     if alpha != 1.0:
         w = alpha * w  # fold alpha into the weight
-    ctx.arg_params[wname] = ndarray.array(np.ascontiguousarray(w))
+    ctx.arg_params[node.inputs[1]] = ndarray.array(np.ascontiguousarray(w))
     if len(ins) > 2 and beta != 1.0:
         bname = node.inputs[2]
         b = ctx.arg_params[bname].asnumpy()

@@ -210,6 +210,12 @@ class DetRandomCropAug(DetAugmenter):
             visible = label[areas > 2]
             if visible.shape[0] < 1:
                 return src, label
+            # NOTE: zero-coverage objects are excluded before the min, so a
+            # window may entirely exclude an object and still satisfy
+            # min_object_covered; those objects are then dropped by
+            # _remap_boxes. This matches the reference sampler exactly
+            # (detection.py:249-250 filters `coverages > 0` the same way) —
+            # the constraint governs partially-visible objects only.
             cov = _coverage_in_window(visible, wx1, wy1, wx2, wy2)
             cov = cov[cov > 0]
             if cov.size == 0 or cov.min() <= self.min_object_covered:

@@ -77,15 +77,34 @@ class DevicePrefetcher:
 
     next = __next__  # DataIter-style alias
 
-    def close(self):
-        """Stop the background thread without draining the source."""
+    def close(self, timeout=2.0):
+        """Stop the background thread without draining the source.
+
+        Joins the worker (bounded wait) so that by the time close()
+        returns no stale worker can still pull from the shared source —
+        fit() re-wraps the same DataIter next epoch, and a lingering
+        worker would race its reset()/next() and swallow a batch.
+        """
+        import time as _time
+        import warnings
         self._stop.set()
-        # unblock a worker waiting on a full queue
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+        deadline = _time.monotonic() + timeout
+        while self._thread.is_alive() and _time.monotonic() < deadline:
+            # unblock a worker waiting on a full queue, repeatedly: it may
+            # complete one more put after each drain before seeing _stop
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(0.1)
+        if self._thread.is_alive():
+            warnings.warn(
+                "DevicePrefetcher.close: worker still blocked in the source "
+                "after %.1fs; it may consume one more batch before exiting"
+                % timeout, RuntimeWarning)
+            return False
+        return True
 
 
 def stage_databatch(batch):

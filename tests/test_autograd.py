@@ -411,3 +411,29 @@ def test_create_graph_rejects_mutated_between_uses():
         y = a + b
         with pytest.raises(mx.MXNetError):
             autograd.grad(y, [x], create_graph=True)
+
+
+def test_create_graph_penalty_reaches_other_leaves():
+    """WGAN-GP pattern: grad w.r.t. x, penalty backprops into w too."""
+    x = nd.array([2.0]); w = nd.array([3.0])
+    x.attach_grad(); w.attach_grad()
+    with autograd.record():
+        y = x * x * w           # dy/dx = 2xw
+        (dx,) = autograd.grad(y, [x], create_graph=True)
+        penalty = dx * dx       # (2xw)^2 ; d/dw = 8x^2 w ; d/dx = 8xw^2
+        penalty.backward()
+    assert np.allclose(w.grad.asnumpy(), [8 * 4 * 3.0])
+    assert np.allclose(x.grad.asnumpy(), [8 * 2 * 9.0])
+
+
+def test_deep_chain_no_recursion_error():
+    import sys
+    x = nd.array([1.0])
+    x.attach_grad()
+    n = sys.getrecursionlimit() + 200
+    with autograd.record():
+        y = x
+        for _ in range(n):
+            y = y + 0.001
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [1.0])

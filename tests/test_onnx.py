@@ -282,3 +282,43 @@ def test_import_lstm_omitted_middle_output(tmp_path):
     ref_c = outs[2].asnumpy()
     assert got_c.shape == ref_c.shape
     assert np.allclose(got_c, ref_c, atol=1e-5), np.abs(got_c - ref_c).max()
+
+
+def test_softmax_activation_export_modes(tmp_path):
+    """SoftmaxActivation has no axis param: channel mode -> Softmax(axis=1);
+    instance mode -> Flatten+Softmax+Reshape (reference:
+    nn/softmax_activation-inl.h)."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxActivation(data, mode="channel")
+    path = str(tmp_path / "sm_chan.onnx")
+    export_model(out, {}, [(2, 3, 4, 4)], onnx_file_path=path)
+    from mxnet_tpu.contrib.onnx import _proto as P
+    m = P.load(path)
+    nodes = [(n.op_type, dict(n.attrs)) for n in m.graph.nodes]
+    assert nodes[-1][0] == "Softmax" and nodes[-1][1].get("axis") == 1
+
+    out = mx.sym.SoftmaxActivation(data)  # instance mode
+    path = str(tmp_path / "sm_inst.onnx")
+    export_model(out, {}, [(2, 3, 4, 4)], onnx_file_path=path)
+    m = P.load(path)
+    types = [n.op_type for n in m.graph.nodes]
+    assert types == ["Flatten", "Softmax", "Shape", "Reshape"]
+
+
+def test_import_weight_from_node_output_is_actionable(tmp_path):
+    """A Conv weight produced by another node must raise MXNetError, not
+    KeyError (valid ONNX, unsupported here)."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+    import mxnet_tpu as mx
+    g = P.Graph("g")
+    g.initializers.append(P.Tensor("w_raw", np.ones((4, 3, 3, 3), np.float32)))
+    g.inputs.append(P.ValueInfo("data", P.FLOAT, [1, 3, 8, 8]))
+    g.nodes.append(P.Node("Identity", ["w_raw"], ["w"], "id0"))
+    g.nodes.append(P.Node("Conv", ["data", "w"], ["y"], "conv0",
+                          {"kernel_shape": [3, 3]}))
+    g.outputs.append(P.ValueInfo("y", P.FLOAT, None))
+    path = str(tmp_path / "nodew.onnx")
+    P.save(P.Model(g, opset=13), path)
+    with pytest.raises(mx.MXNetError, match="initializer"):
+        import_model(path)

@@ -157,3 +157,29 @@ def test_module_fit_through_prefetcher():
     it.reset()
     score = mod.score(it, "acc")
     assert dict(score)["accuracy"] > 0.8
+
+
+def test_close_joins_worker_before_return():
+    """close() must not return while the worker can still pull from the
+    shared source: a lingering worker races the next epoch's reset()."""
+    import threading
+    from mxnet_tpu.parallel.prefetch import DevicePrefetcher
+
+    pulled = []
+    release = threading.Event()
+
+    def slow_source():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+            release.wait(0.05)
+
+    pf = DevicePrefetcher(slow_source(), depth=1)
+    assert next(pf) == 0
+    pf.close()
+    assert not pf._thread.is_alive()
+    n = len(pulled)
+    release.set()
+    import time
+    time.sleep(0.2)
+    assert len(pulled) == n  # no pulls after close() returned
