@@ -5,6 +5,7 @@ Reference: python/mxnet/module/base_module.py (fit :399, loop body
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 
@@ -18,25 +19,26 @@ from ..initializer import Uniform
 from ..ndarray import NDArray
 
 
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
+
 def _check_input_names(symbol, names, typename, throw):
-    """Check that input names are in symbol's arguments (reference:
-    base_module.py:33)."""
+    """Validate declared data/label names against the symbol's free
+    arguments (reference role: base_module.py:33)."""
     args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [arg for arg in args if
-                      not arg.endswith("_weight") and
-                      not arg.endswith("_bias") and
-                      not arg.endswith("_gamma") and
-                      not arg.endswith("_beta")]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
-        if throw:
-            raise ValueError(msg)
-        logging.warning(msg)
+    missing = [n for n in names if n not in args]
+    if not missing:
+        return
+    # suggest the non-parameter arguments — those are the plausible
+    # data/label slots the caller probably meant
+    slots = [a for a in args if not a.endswith(_PARAM_SUFFIXES)]
+    msg = ("%s_names=%s: %r is not among the symbol's arguments; "
+           "plausible %s inputs of this symbol: %s"
+           % (typename, list(names), missing[0], typename,
+              ", ".join(slots) or "<none>"))
+    if throw:
+        raise ValueError(msg)
+    logging.warning(msg)
 
 
 class BaseModule:
@@ -44,13 +46,18 @@ class BaseModule:
 
     def __init__(self, logger=logging):
         self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
-        self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
+        # lifecycle flags, flipped by bind/init_params/init_optimizer
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+        self.inputs_need_grad = False
+
+    def _require_bound_and_initialized(self):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("call bind() and init_params() first")
 
     # ------------------------------------------------------------------
     # properties subclasses provide
@@ -92,87 +99,76 @@ class BaseModule:
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
         """Evaluate (reference: base_module.py:176)."""
-        assert self.binded and self.params_initialized
+        self._require_bound_and_initialized()
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        seen = 0
+        for batch in eval_data:
+            if num_batch is not None and seen >= num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            if isinstance(eval_batch, list):
-                self.update_metric(eval_metric, [eb.label for eb in
-                                                 eval_batch], pre_sliced=True)
-            else:
-                self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            self.forward(batch, is_train=False)
+            labels = ([b.label for b in batch]
+                      if isinstance(batch, list) else batch.label)
+            self.update_metric(eval_metric, labels,
+                               pre_sliced=isinstance(batch, list))
+            for cb in _as_list(batch_end_callback):
+                cb(BatchEndParam(epoch=epoch, nbatch=seen,
+                                 eval_metric=eval_metric, locals=locals()))
+            seen += 1
+        for cb in _as_list(score_end_callback):
+            cb(BatchEndParam(epoch=epoch, nbatch=seen,
+                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
+    def _depadded_outputs(self, batch):
+        """Forward outputs with the iterator's tail padding sliced away."""
+        keep = None
+        if getattr(batch, "pad", None):
+            keep = -batch.pad
+        return [o[:keep] if keep else o for o in self.get_outputs()]
+
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
+        self._require_bound_and_initialized()
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
-                       for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+            self.forward(batch, is_train=False)
+            yield (self._depadded_outputs(batch), i, batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False,
                 sparse_row_id_fn=None):
         """Run prediction, collect outputs (reference: base_module.py:268)."""
-        assert self.binded and self.params_initialized
-        if isinstance(eval_data, (NDArray, np.ndarray)):
-            if isinstance(eval_data, np.ndarray):
-                eval_data = NDArray(eval_data)
+        self._require_bound_and_initialized()
+        if isinstance(eval_data, np.ndarray):
+            eval_data = NDArray(eval_data)
+        if isinstance(eval_data, NDArray):
             eval_data = mx_io.NDArrayIter(eval_data.asnumpy(),
                                           batch_size=eval_data.shape[0])
         if not isinstance(eval_data, mx_io.DataIter):
-            raise ValueError("eval_data must be of type NDArray or DataIter")
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                if len(out) != num_outputs:
-                    raise ValueError("Cannot merge batches: different number "
-                                     "of outputs per batch")
-            from .. import ndarray as nd
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+            raise ValueError("predict wants an NDArray, numpy array, or "
+                             "DataIter; got %s" % type(eval_data).__name__)
+        per_batch = [outs for outs, _, _ in
+                     self.iter_predict(eval_data, num_batch=num_batch,
+                                       reset=reset)]
+        per_batch = [[o.copy() for o in outs] for outs in per_batch]
+        if not per_batch or not merge_batches:
+            return per_batch
+        arity = {len(outs) for outs in per_batch}
+        if len(arity) != 1:
+            raise ValueError("cannot merge prediction batches with varying "
+                             "output arity %s" % sorted(arity))
+        from .. import ndarray as nd
+        merged = [nd.concatenate([outs[i] for outs in per_batch])
+                  for i in range(arity.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -202,77 +198,92 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
 
         ################################################################
-        # training loop (reference: base_module.py:491-560)
+        # training loop (reference role: base_module.py:491-560)
         ################################################################
-        from ..parallel.prefetch import DevicePrefetcher, stage_databatch
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            started = time.time()
             eval_metric.reset()
-            nbatch = 0
-            # host→device double buffering: a background thread decodes
-            # and stages batch k+1 while step k runs (reference:
-            # src/io/iter_prefetcher.h wraps every training iterator)
-            data_iter = DevicePrefetcher(iter(train_data),
-                                         stage_databatch, depth=2)
-            try:
-                end_of_batch = False
-                next_data_batch = next(data_iter)
-                while not end_of_batch:
-                    data_batch = next_data_batch
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                    if isinstance(data_batch, list):
-                        self.update_metric(
-                            eval_metric,
-                            [db.label for db in data_batch],
-                            pre_sliced=True)
-                    else:
-                        self.update_metric(eval_metric, data_batch.label)
-                    try:
-                        next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    except StopIteration:
-                        end_of_batch = True
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if end_of_batch:
-                        eval_name_vals = eval_metric.get_name_value()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
-                    nbatch += 1
-            finally:
-                # an exception mid-epoch must not leak a worker thread
-                # still pulling from the shared underlying iterator
-                data_iter.close()
+            final_metrics = self._run_train_epoch(
+                train_data, epoch, eval_metric, monitor,
+                batch_end_callback, sparse_row_id_fn)
 
-            for name, val in eval_name_vals:
+            for name, val in final_metrics:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - started)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # checkpoint-consistency sync: pull the device params into the
+            # host-side dicts epoch callbacks (do_checkpoint) will read
+            synced_args, synced_aux = self.get_params()
+            self.set_params(synced_args, synced_aux)
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, synced_args, synced_aux)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+
+    def _run_train_epoch(self, train_data, epoch, eval_metric, monitor,
+                         batch_end_callback, sparse_row_id_fn):
+        """One epoch of the fit loop, with one-batch lookahead: prepare()
+        sees batch k+1 while the device still works on k, and the last
+        batch is known as such before its callbacks run."""
+        from ..parallel.prefetch import DevicePrefetcher, stage_databatch
+
+        # host→device double buffering: a background thread decodes and
+        # stages upcoming batches (reference: src/io/iter_prefetcher.h
+        # wraps every training iterator)
+        staged = DevicePrefetcher(iter(train_data), stage_databatch, depth=2)
+        final_metrics = []
+        try:
+            pending = None       # batch waiting to be processed
+            for nbatch_next in itertools.count(0):
+                try:
+                    upcoming = next(staged)
+                except StopIteration:
+                    upcoming = None
+                if pending is None:
+                    if upcoming is None:
+                        break    # empty iterator
+                    pending = upcoming
+                    continue
+                batch, is_last = pending, upcoming is None
+                nbatch = nbatch_next - 1
+                if upcoming is not None:
+                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(batch)
+                self.update()
+                if isinstance(batch, list):  # pre-sliced multi-device form
+                    self.update_metric(eval_metric,
+                                       [b.label for b in batch],
+                                       pre_sliced=True)
+                else:
+                    self.update_metric(eval_metric, batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if is_last:
+                    # read before batch callbacks, which may reset metrics
+                    final_metrics = eval_metric.get_name_value()
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=eval_metric,
+                                     locals=locals()))
+                pending = upcoming
+                if is_last:
+                    break
+        finally:
+            # an exception mid-epoch must not leak a worker thread still
+            # pulling from the shared underlying iterator
+            staged.close()
+        return final_metrics
 
     # ------------------------------------------------------------------
     # parameters
@@ -292,26 +303,23 @@ class BaseModule:
                          force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
         from .. import ndarray as nd
-        nd.save(fname, save_dict)
+        args, auxs = self.get_params()
+        nd.save(fname, dict(
+            [("arg:" + k, v) for k, v in args.items()]
+            + [("aux:" + k, v) for k, v in auxs.items()]))
 
     def load_params(self, fname):
         from .. import ndarray as nd
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
+        groups = {"arg": {}, "aux": {}}
+        for tagged, value in nd.load(fname).items():
+            kind, _, name = tagged.partition(":")
+            if kind not in groups or not name:
+                raise ValueError(
+                    "%s: entry %r is not arg:/aux:-tagged — not a Module "
+                    "checkpoint" % (fname, tagged))
+            groups[kind][name] = value
+        self.set_params(groups["arg"], groups["aux"])
 
     # ------------------------------------------------------------------
     # computation interface subclasses provide

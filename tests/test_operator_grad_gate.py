@@ -582,7 +582,10 @@ def _run_case(name, spec):
         flat = base.reshape(-1)
         # sample a handful of coordinates — enough to catch a wrong vjp,
         # cheap enough to run registry-wide
-        idxs = RNG.choice(flat.size, size=min(4, flat.size), replace=False)
+        import zlib
+        rng = np.random.RandomState(
+            (zlib.crc32(name.encode()) ^ (j << 16)) & 0x7fffffff)
+        idxs = rng.choice(flat.size, size=min(4, flat.size), replace=False)
         for idx in idxs:
             fp = flat.copy(); fp[idx] += eps
             fm = flat.copy(); fm[idx] -= eps

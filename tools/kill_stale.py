@@ -179,6 +179,8 @@ def main(argv=None):
         print("  -> killed")
     if args.kill:
         print("kill_stale: killed %d/%d" % (killed, len(cands)))
+    else:
+        print("kill_stale: %d candidate(s) listed (no --kill)" % len(cands))
     return 0
 
 
