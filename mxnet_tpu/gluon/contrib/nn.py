@@ -9,7 +9,8 @@ from ..block import HybridBlock
 from ..nn import Sequential, HybridSequential
 from ... import symbol as _sym
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "SparseEmbedding", "RingAttention", "MoEFFN"]
 
 
 class Concurrent(Sequential):
@@ -70,3 +71,76 @@ class SparseEmbedding(HybridBlock):
     def __repr__(self):
         return "SparseEmbedding({input_dim} -> {output_dim}, {dtype})" \
             .format(**self._kwargs)
+
+
+class RingAttention(HybridBlock):
+    """Sequence-parallel multi-head attention layer.
+
+    Wraps the `_contrib_RingAttention` frontend op so HybridBlock models
+    get ring attention (blockwise K/V rotation over the `sp` mesh axis,
+    parallel/ring_attention.py) without touching raw jax: inside a
+    `parallel.use_mesh` scope with `axis_name` present the K/V ring runs
+    over ICI; on a single device it degrades to ordinary attention.
+    Inputs are (batch, heads, seq, head_dim) q/k/v — projections belong
+    to the surrounding model. No reference analog (the 2018 reference
+    has no sequence parallelism; SURVEY.md §2.3)."""
+
+    def __init__(self, causal=True, axis_name="sp", **kwargs):
+        super().__init__(**kwargs)
+        self._causal = bool(causal)
+        self._axis_name = axis_name
+
+    def hybrid_forward(self, F, q, k, v):
+        return F.contrib.RingAttention(q, k, v, causal=self._causal,
+                                       axis_name=self._axis_name)
+
+    def __repr__(self):
+        return "RingAttention(causal=%s, axis=%r)" % (self._causal,
+                                                      self._axis_name)
+
+
+class MoEFFN(HybridBlock):
+    """Mixture-of-Experts feed-forward layer (top-k token routing).
+
+    Owns the gate + per-expert FFN parameters and wraps the
+    `_contrib_MoEFFN` frontend op: under a `parallel.use_mesh` scope
+    with `axis_name` on the mesh, tokens all_to_all to their experts
+    (expert parallelism, parallel/moe.py); otherwise a dense fallback
+    runs the same math on one device. Returns (output, aux_loss) —
+    add `aux_loss_weight * aux_loss` to the training loss to keep the
+    router balanced. No reference analog (SURVEY.md §2.3)."""
+
+    def __init__(self, embed_dim, hidden_size, num_experts, top_k=2,
+                 capacity_factor=2.0, axis_name="ep", dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._attrs = dict(top_k=int(top_k),
+                           capacity_factor=float(capacity_factor),
+                           axis_name=axis_name)
+        E, D, H = int(num_experts), int(embed_dim), int(hidden_size)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(D, E), init=weight_initializer,
+                dtype=dtype)
+            self.expert_w1 = self.params.get(
+                "expert_w1_weight", shape=(E, D, H),
+                init=weight_initializer, dtype=dtype)
+            self.expert_b1 = self.params.get(
+                "expert_b1_bias", shape=(E, H), init="zeros", dtype=dtype)
+            self.expert_w2 = self.params.get(
+                "expert_w2_weight", shape=(E, H, D),
+                init=weight_initializer, dtype=dtype)
+            self.expert_b2 = self.params.get(
+                "expert_b2_bias", shape=(E, D), init="zeros", dtype=dtype)
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        return F.contrib.MoEFFN(x, gate_weight, expert_w1, expert_b1,
+                                expert_w2, expert_b2, **self._attrs)
+
+    def __repr__(self):
+        D, E = self.gate_weight.shape
+        H = self.expert_w1.shape[2]
+        return ("MoEFFN(embed=%d, hidden=%d, experts=%d, top_k=%d, "
+                "axis=%r)" % (D, H, E, self._attrs["top_k"],
+                              self._attrs["axis_name"]))

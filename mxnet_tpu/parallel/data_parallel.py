@@ -412,9 +412,11 @@ class ShardedTrainer:
             self._input_ndims = ndims
             self._build_step_many()
         key = _random.next_key() if self._needs_rng else None
-        self._params, self._aux, self._opt_state, losses = \
-            self._step_many_fn(self._params, self._aux, self._opt_state,
-                               inputs, key, int(n_steps), int(unroll))
+        from .mesh import use_mesh
+        with use_mesh(self._mesh):
+            self._params, self._aux, self._opt_state, losses = \
+                self._step_many_fn(self._params, self._aux, self._opt_state,
+                                   inputs, key, int(n_steps), int(unroll))
         self._step_count += int(n_steps)
         return NDArray(losses)
 
@@ -596,13 +598,19 @@ class ShardedTrainer:
             else:
                 self._build_step()
         key = _random.next_key() if self._needs_rng else None
-        if self._grad_compression is not None:
-            (self._params, self._aux, self._opt_state, self._gc_residuals,
-             loss) = self._step_fn(self._params, self._aux, self._opt_state,
-                                   self._gc_residuals, inputs, key)
-        else:
-            self._params, self._aux, self._opt_state, loss = self._step_fn(
-                self._params, self._aux, self._opt_state, inputs, key)
+        # trace (first call) under this trainer's mesh so mesh-aware ops
+        # (contrib.RingAttention / contrib.MoEFFN) pick their sp/ep paths
+        from .mesh import use_mesh
+        with use_mesh(self._mesh):
+            if self._grad_compression is not None:
+                (self._params, self._aux, self._opt_state,
+                 self._gc_residuals, loss) = self._step_fn(
+                    self._params, self._aux, self._opt_state,
+                    self._gc_residuals, inputs, key)
+            else:
+                (self._params, self._aux, self._opt_state,
+                 loss) = self._step_fn(
+                    self._params, self._aux, self._opt_state, inputs, key)
         self._step_count += 1
         return NDArray(loss)
 

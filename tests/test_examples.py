@@ -95,3 +95,9 @@ def test_cnn_text_classification():
 def test_neural_style():
     out = run_example("neural_style/neural_style.py", "--steps", "45")
     assert "final loss" in out
+
+
+def test_transformer_pipeline_bucketed():
+    out = run_example("transformer/train_pipeline_bucketed.py",
+                      "--steps", "24")
+    assert "PIPELINE_BUCKETED_OK" in out

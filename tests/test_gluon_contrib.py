@@ -79,3 +79,113 @@ def test_interval_sampler():
     assert list(IntervalSampler(6, 2)) == [0, 2, 4, 1, 3, 5]
     assert list(IntervalSampler(6, 2, rollover=False)) == [0, 2, 4]
     assert len(IntervalSampler(6, 2)) == 6
+
+
+# ---------------------------------------------------------------------------
+# advanced-parallelism blocks (VERDICT r4 #8): RingAttention / MoEFFN usable
+# from HybridBlock + ShardedTrainer without raw jax
+# ---------------------------------------------------------------------------
+
+
+def test_moe_ffn_block_eager_hybrid_parity():
+    from mxnet_tpu.gluon.contrib.nn import MoEFFN
+    np.random.seed(1)
+    moe = MoEFFN(embed_dim=8, hidden_size=16, num_experts=4)
+    moe.initialize()
+    x = mx.nd.array(np.random.randn(6, 8).astype("float32"))
+    out, aux = moe(x)
+    moe.hybridize()
+    out2, aux2 = moe(x)
+    assert np.allclose(out.asnumpy(), out2.asnumpy(), atol=1e-5)
+    assert out.shape == (6, 8) and aux.shape == ()
+
+
+def test_ring_attention_block_matches_softmax_attention():
+    from mxnet_tpu.gluon.contrib.nn import RingAttention
+    np.random.seed(2)
+    q = np.random.randn(2, 2, 8, 4).astype("float32")
+    att = RingAttention(causal=False)
+    out = att(mx.nd.array(q), mx.nd.array(q), mx.nd.array(q)).asnumpy()
+    # oracle
+    s = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(4)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    exp = np.einsum("bhqk,bhkd->bhqd", p, q)
+    assert np.allclose(out, exp, atol=1e-4)
+
+
+def test_moe_block_trains_under_sharded_trainer_ep_mesh():
+    from mxnet_tpu.gluon.contrib.nn import MoEFFN
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    class MoENet(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.moe = MoEFFN(embed_dim=8, hidden_size=16,
+                                  num_experts=4)
+                self.head = nn.Dense(1)
+
+        def hybrid_forward(self, F, x):
+            h, aux = self.moe(x)
+            return self.head(h), aux
+
+    np.random.seed(0)
+    X = np.random.randn(64, 8).astype("float32")
+    Y = (X[:, :1] * 2 + X[:, 1:2]).astype("float32")
+    net = MoENet()
+    net.initialize()
+    net(mx.nd.array(X[:4]))
+    mesh = make_mesh({"dp": 2, "ep": 4})
+
+    def loss_fn(out, label):
+        pred, aux = out
+        return gluon.loss.L2Loss()(pred, label) + 0.01 * aux
+
+    st = ShardedTrainer(net, loss_fn, "adam", {"learning_rate": 0.02},
+                        mesh=mesh)
+    first = float(st.step(X, Y).asscalar())
+    for _ in range(80):
+        loss = st.step(X, Y)
+    assert float(loss.asscalar()) < first * 0.3
+
+
+def test_ring_attention_block_trains_under_sharded_trainer_sp_mesh():
+    from mxnet_tpu.gluon.contrib.nn import RingAttention
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    class AttNet(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = nn.Dense(16, flatten=False)  # -> q|k|v  
+                self.att = RingAttention(causal=True)
+                self.head = nn.Dense(1, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            qkv = self.proj(x)                       # (B, S, 16)
+
+            def heads(lo, hi):
+                h = F.slice_axis(qkv, axis=-1, begin=lo, end=hi)
+                h = F.reshape(h, shape=(0, 0, 1, 4))  # (B, S, 1, 4)
+                return F.transpose(h, axes=(0, 2, 1, 3))
+
+            o = self.att(heads(0, 4), heads(4, 8), heads(8, 12))
+            o = F.reshape(F.transpose(o, axes=(0, 2, 1, 3)),
+                          shape=(0, 0, -1))
+            return self.head(o)
+
+    np.random.seed(3)
+    B, S = 4, 16
+    X = np.random.randn(B, S, 8).astype("float32")
+    Y = np.cumsum(X[:, :, :1], axis=1).astype("float32")  # causal target
+    net = AttNet()
+    net.initialize()
+    net(mx.nd.array(X[:2]))
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    st = ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                        "adam", {"learning_rate": 0.02}, mesh=mesh)
+    first = float(st.step(X, Y).asscalar())
+    for _ in range(60):
+        loss = st.step(X, Y)
+    assert float(loss.asscalar()) < first * 0.5
