@@ -101,3 +101,24 @@ def test_transformer_pipeline_bucketed():
     out = run_example("transformer/train_pipeline_bucketed.py",
                       "--steps", "24")
     assert "PIPELINE_BUCKETED_OK" in out
+
+
+def test_ctc_lstm_ocr():
+    # loss-only: full decode convergence takes ~6 min on a 1-core VM
+    # (the example's default config reaches 100% exact-sequence acc);
+    # the smoke asserts the loss collapse phase
+    out = run_example("ctc/lstm_ocr.py", "--epochs", "5",
+                      "--train-size", "256", "--loss-only",
+                      timeout=540)
+    assert "CTC_OCR_OK" in out
+
+
+def test_nce_toy():
+    out = run_example("nce-loss/toy_nce.py", "--epochs", "8",
+                      "--train-size", "4096")
+    assert "NCE_OK" in out
+
+
+def test_multi_task():
+    out = run_example("multi-task/multi_task.py", "--epochs", "6")
+    assert "MULTI_TASK_OK" in out
