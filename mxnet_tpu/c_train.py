@@ -1,0 +1,105 @@
+"""Python half of the C training API (src/c_api.cc).
+
+Reference: the c_api.h training surface (MXSymbolCreateFromJSON,
+MXExecutorSimpleBind / MXExecutorForward+Backward, KVStore updates —
+src/c_api/c_api_symbolic.cc, c_api_executor.cc) that lets a non-Python
+host build a model and fit it. The TPU-native C shim keeps marshalling
+in C and drives this helper: a CTrainer wraps a Module end-to-end
+(bind, init, fused fwd+bwd step, optimizer update) so one C call runs
+one training step as one XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CTrainer", "create_trainer"]
+
+
+class CTrainer:
+    """A bound Module with byte-buffer I/O for the C ABI."""
+
+    def __init__(self, sym, data_shapes, label_shape, label_name,
+                 optimizer, opt_params):
+        from . import io as mx_io
+        from .module import Module
+        from . import context
+
+        self._data_names = list(data_shapes)
+        self._data_shapes = {k: tuple(int(d) for d in v)
+                             for k, v in data_shapes.items()}
+        self._label_name = label_name
+        self._label_shape = tuple(int(d) for d in label_shape)
+        self._mod = Module(sym, data_names=tuple(self._data_names),
+                           label_names=(label_name,),
+                           context=context.current_context())
+        self._mod.bind(
+            data_shapes=[(k, self._data_shapes[k])
+                         for k in self._data_names],
+            label_shapes=[(label_name, self._label_shape)],
+            for_training=True)
+        self._mod.init_params()
+        self._mod.init_optimizer(
+            optimizer=optimizer,
+            optimizer_params=tuple(opt_params.items()))
+        self._batch_cls = mx_io.DataBatch
+
+    def step(self, data_bufs, label_buf):
+        """One fused train step from raw float32 buffers; returns the
+        mean cross-entropy of this batch (computed from the head's
+        softmax outputs, the way Module.fit's metric sees them)."""
+        from .ndarray import array
+
+        datas = []
+        for name, buf in zip(self._data_names, data_bufs):
+            arr = np.frombuffer(buf, dtype=np.float32).reshape(
+                self._data_shapes[name])
+            datas.append(array(arr))
+        label_np = np.frombuffer(label_buf, dtype=np.float32).reshape(
+            self._label_shape)
+        label = array(label_np)
+        batch = self._batch_cls(data=datas, label=[label])
+        self._mod.forward_backward(batch)
+        self._mod.update()
+        probs = self._mod.get_outputs()[0].asnumpy()
+        idx = label_np.astype("int64").reshape(-1)
+        ce = -np.log(np.maximum(
+            probs.reshape(len(idx), -1)[np.arange(len(idx)), idx], 1e-12))
+        return float(ce.mean())
+
+    def save_params(self, path):
+        self._mod.save_params(path)
+        return True
+
+
+def _parse_opt_value(v):
+    """C ABI optimizer params arrive as strings; parse like the
+    imperative-invoke path (numbers/bools/None preserved, the rest kept
+    as strings) rather than coercing through atof."""
+    if not isinstance(v, str):
+        return v
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def create_trainer(sym, shapes, label_name, optimizer, opt_params):
+    """MXTrainerCreate body. `shapes` maps every declared input name to
+    its shape; the label is split out by `label_name`."""
+    if label_name not in shapes:
+        raise MXNetError("trainer: label %r missing from input shapes"
+                         % label_name)
+    data_shapes = {k: v for k, v in shapes.items() if k != label_name}
+    if len(data_shapes) != 1:
+        # MXTrainerStep marshals exactly one data buffer — fail at
+        # create time, not deep inside graph binding on the first step
+        raise MXNetError(
+            "the C trainer surface supports exactly one data input; got "
+            "%s (drive multi-input models via MXInvokeCachedOp)"
+            % sorted(data_shapes))
+    return CTrainer(sym, data_shapes, shapes[label_name], label_name,
+                    optimizer,
+                    {k: _parse_opt_value(v) for k, v in opt_params.items()})

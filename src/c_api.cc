@@ -18,6 +18,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -316,6 +317,252 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
   PyGILState_Release(st);
   return rc;
 }
+
+// ---------------------------------------------------------------------
+// symbol + CachedOp + trainer: the minimum C training surface
+// (reference: c_api_symbolic.cc MXSymbolCreateFromJSON /
+// ListArguments, c_api_ndarray.cc MXCreateCachedOp/MXInvokeCachedOp,
+// and the executor+KVStore fit path of c_api_executor.cc — here one
+// MXTrainerStep call runs the fused fwd+bwd+update XLA program)
+// ---------------------------------------------------------------------
+
+// generic owner of a python object exposed as an opaque handle
+struct PyHandle {
+  PyObject *obj;
+  std::vector<std::string> strs;        // string-list return storage
+  std::vector<const char *> str_ptrs;
+};
+
+typedef void *SymbolHandle;
+typedef void *CachedOpHandle;
+typedef void *TrainerHandle;
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(s)", json);
+  PyObject *sym = call_expr(
+      "lambda j: mxnet_tpu.symbol.load_json(j)", args);
+  Py_XDECREF(args);
+  if (sym) {
+    *out = new PyHandle{sym, {}, {}};
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXSymbolCreateFromFile(const char *path, SymbolHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(s)", path);
+  PyObject *sym = call_expr("lambda p: mxnet_tpu.symbol.load(p)", args);
+  Py_XDECREF(args);
+  if (sym) {
+    *out = new PyHandle{sym, {}, {}};
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, int *out_size,
+                          const char ***out_names) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(O)", h->obj);
+  PyObject *names = call_expr("lambda s: list(s.list_arguments())", args);
+  Py_XDECREF(args);
+  if (names) {
+    h->strs.clear();
+    h->str_ptrs.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+      const char *c = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+      h->strs.emplace_back(c ? c : "");
+    }
+    for (auto &s : h->strs) h->str_ptrs.push_back(s.c_str());
+    Py_DECREF(names);
+    *out_size = static_cast<int>(h->str_ptrs.size());
+    *out_names = h->str_ptrs.data();
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  if (!handle) return 0;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  PyGILState_Release(st);
+  return 0;
+}
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(O)",
+                                 static_cast<PyHandle *>(sym)->obj);
+  PyObject *op = call_expr(
+      "lambda s: mxnet_tpu.cached_op.CachedOp(s)", args);
+  Py_XDECREF(args);
+  if (op) {
+    *out = new PyHandle{op, {}, {}};
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) { return MXSymbolFree(handle); }
+
+// inputs follow the symbol's list_inputs() order, exactly like the
+// reference's MXInvokeCachedOp
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  int rc = -1;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<Handle *>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject *args = Py_BuildValue("(OO)", h->obj, ins);
+  Py_DECREF(ins);
+  PyObject *res = call_expr(
+      "lambda op, ins: (lambda r: r if isinstance(r, list) else [r])("
+      "op(*ins))",
+      args);
+  Py_XDECREF(args);
+  if (res) {
+    Py_ssize_t n = PyList_Size(res);
+    static thread_local std::vector<NDArrayHandle> out_handles;
+    out_handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *o = PyList_GetItem(res, i);  // borrowed
+      Py_INCREF(o);
+      Handle *nh = new Handle{o, {}};
+      refresh_shape(nh);
+      out_handles.push_back(nh);
+    }
+    Py_DECREF(res);
+    *num_outputs = static_cast<int>(n);
+    *outputs = out_handles.data();
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTrainerCreate(SymbolHandle sym, int num_inputs,
+                    const char **input_keys, const int64_t **shapes,
+                    const int *ndims, const char *label_name,
+                    const char *optimizer, int num_opt,
+                    const char **opt_keys, const char **opt_vals,
+                    TrainerHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shape_dict = PyDict_New();
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *t = PyTuple_New(ndims[i]);
+    for (int j = 0; j < ndims[i]; ++j) {
+      PyTuple_SetItem(t, j, PyLong_FromLongLong(shapes[i][j]));
+    }
+    PyDict_SetItemString(shape_dict, input_keys[i], t);
+    Py_DECREF(t);
+  }
+  PyObject *opt = PyDict_New();
+  for (int i = 0; i < num_opt; ++i) {
+    // strings; the python side literal_eval-parses (atof would
+    // silently zero non-numeric values like "True")
+    PyObject *v = PyUnicode_FromString(opt_vals[i]);
+    PyDict_SetItemString(opt, opt_keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject *args = Py_BuildValue(
+      "(OOssO)", static_cast<PyHandle *>(sym)->obj, shape_dict,
+      label_name, optimizer, opt);
+  Py_DECREF(shape_dict);
+  Py_DECREF(opt);
+  PyObject *tr = call_expr(
+      "lambda s, shapes, lbl, o, op: __import__('mxnet_tpu.c_train', "
+      "fromlist=['c']).create_trainer(s, shapes, lbl, o, op)",
+      args);
+  Py_XDECREF(args);
+  if (tr) {
+    *out = new PyHandle{tr, {}, {}};
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTrainerStep(TrainerHandle handle, const float *data,
+                  size_t data_floats, const float *label,
+                  size_t label_floats, float *loss_out) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  int rc = -1;
+  // zero-copy views: the call is synchronous and np.frombuffer only
+  // reads, so the C buffers stay valid for the duration
+  PyObject *dview = PyMemoryView_FromMemory(
+      (char *)data, (Py_ssize_t)(data_floats * sizeof(float)),
+      PyBUF_READ);
+  PyObject *lview = PyMemoryView_FromMemory(
+      (char *)label, (Py_ssize_t)(label_floats * sizeof(float)),
+      PyBUF_READ);
+  PyObject *args = Py_BuildValue("(OOO)", h->obj, dview, lview);
+  Py_XDECREF(dview);
+  Py_XDECREF(lview);
+  PyObject *r = call_expr(
+      "lambda t, d, l: t.step([d], l)", args);
+  Py_XDECREF(args);
+  if (r) {
+    *loss_out = static_cast<float>(PyFloat_AsDouble(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTrainerSaveParams(TrainerHandle handle, const char *path) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  PyObject *args = Py_BuildValue("(Os)", h->obj, path);
+  PyObject *r = call_expr("lambda t, p: t.save_params(p)", args);
+  Py_XDECREF(args);
+  int rc = r ? 0 : (set_py_error(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTrainerFree(TrainerHandle handle) { return MXSymbolFree(handle); }
 
 // ---------------------------------------------------------------------
 // predict API (reference: amalgamation/c_predict_api.h — the shape of

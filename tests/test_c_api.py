@@ -225,3 +225,164 @@ def test_embedded_c_host(tmp_path):
                        timeout=300, env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "C_HOST_OK 11 14" in r.stdout
+
+
+C_TRAIN_HOST = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+
+extern int MXSymbolCreateFromFile(const char *path, void **out);
+extern int MXSymbolListArguments(void *sym, int *n, const char ***names);
+extern int MXTrainerCreate(void *sym, int num_inputs,
+                           const char **keys, const int64_t **shapes,
+                           const int *ndims, const char *label_name,
+                           const char *optimizer, int num_opt,
+                           const char **opt_keys, const char **opt_vals,
+                           void **out);
+extern int MXTrainerStep(void *tr, const float *data, size_t nd,
+                         const float *label, size_t nl, float *loss);
+extern int MXTrainerSaveParams(void *tr, const char *path);
+extern int MXTrainerFree(void *tr);
+extern int MXSymbolFree(void *sym);
+extern const char *MXGetLastError();
+
+/* deterministic 2-class problem: class = sign of mean(x) */
+static void make_batch(unsigned *seed, float *x, float *y, int n, int d) {
+  for (int i = 0; i < n; ++i) {
+    int cls = (*seed = *seed * 1103515245u + 12345u) >> 30 & 1;
+    float base = cls ? 0.5f : -0.5f;
+    for (int j = 0; j < d; ++j) {
+      *seed = *seed * 1103515245u + 12345u;
+      x[i * d + j] = base + ((*seed >> 16 & 0xffff) / 65536.0f - 0.5f);
+    }
+    y[i] = (float)cls;
+  }
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) return 10;
+  void *sym = NULL, *tr = NULL;
+  if (MXSymbolCreateFromFile(argv[1], &sym)) {
+    fprintf(stderr, "symbol: %s\n", MXGetLastError());
+    return 1;
+  }
+  int n_args; const char **names;
+  if (MXSymbolListArguments(sym, &n_args, &names)) return 2;
+  printf("symbol has %d arguments\n", n_args);
+
+  const int N = 32, D = 16;
+  const char *keys[2] = {"data", "softmax_label"};
+  int64_t dshape[2] = {N, D}, lshape[1] = {N};
+  const int64_t *shapes[2] = {dshape, lshape};
+  int ndims[2] = {2, 1};
+  const char *ok[1] = {"learning_rate"};
+  const char *ov[1] = {"0.5"};
+  if (MXTrainerCreate(sym, 2, keys, shapes, ndims, "softmax_label",
+                      "sgd", 1, ok, ov, &tr)) {
+    fprintf(stderr, "trainer: %s\n", MXGetLastError());
+    return 3;
+  }
+  float *x = malloc(N * D * sizeof(float));
+  float *y = malloc(N * sizeof(float));
+  unsigned seed = 7;
+  float first = 0, loss = 0;
+  for (int step = 0; step < 30; ++step) {
+    make_batch(&seed, x, y, N, D);
+    if (MXTrainerStep(tr, x, N * D, y, N, &loss)) {
+      fprintf(stderr, "step: %s\n", MXGetLastError());
+      return 4;
+    }
+    if (step == 0) first = loss;
+  }
+  printf("loss %g -> %g\n", first, loss);
+  if (!(loss < 0.5f * first)) return 5;
+  if (MXTrainerSaveParams(tr, argv[2])) return 6;
+  MXTrainerFree(tr);
+  MXSymbolFree(sym);
+  printf("C_TRAIN_OK\n");
+  free(x); free(y);
+  return 0;
+}
+"""
+
+
+def test_embedded_c_host_training(tmp_path):
+    """A compiled C host builds a symbol from JSON, creates a trainer,
+    fits it on synthetic data (loss must halve), and saves params —
+    the c_api_symbolic/executor training path (VERDICT r4 #7)."""
+    # the network the C host trains: an MLP classifier
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=32),
+                          act_type="relu")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2),
+                               mx.sym.Variable("softmax_label"))
+    sym_path = tmp_path / "mlp-symbol.json"
+    out.save(str(sym_path))
+
+    src = tmp_path / "train_host.c"
+    src.write_text(C_TRAIN_HOST)
+    exe = str(tmp_path / "train_host")
+    r = subprocess.run(
+        ["gcc", str(src), "-o", exe, "-L" + os.path.join(REPO, "src"),
+         "-lmxtpu_capi", "-Wl,-rpath," + os.path.join(REPO, "src")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = REPO
+    env["MXTPU_CAPI_PLATFORM"] = "cpu"
+    params_path = str(tmp_path / "trained.params")
+    r = subprocess.run([exe, str(sym_path), params_path],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "C_TRAIN_OK" in r.stdout
+    # the checkpoint the C host saved loads back in python
+    loaded = mx.nd.load(params_path)
+    assert any(k.startswith("arg:") for k in loaded)
+
+
+def test_cached_op_c_abi():
+    """Symbol-from-JSON + CachedOp create/invoke through ctypes."""
+    lib = _lib()
+    lib.MXSymbolCreateFromJSON.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXCreateCachedOp.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXInvokeCachedOp.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p))]
+    lib.MXFreeCachedOp.argtypes = [ctypes.c_void_p]
+    lib.MXSymbolFree.argtypes = [ctypes.c_void_p]
+
+    x = mx.sym.Variable("x")
+    sym = 2 * x + 1
+    h_sym = ctypes.c_void_p()
+    rc = lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                    ctypes.byref(h_sym))
+    assert rc == 0, lib.MXGetLastError()
+    h_op = ctypes.c_void_p()
+    assert lib.MXCreateCachedOp(h_sym, ctypes.byref(h_op)) == 0, \
+        lib.MXGetLastError()
+
+    shape = (ctypes.c_int64 * 2)(2, 3)
+    h_in = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h_in)) == 0
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h_in, vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes) == 0
+
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(h_in)
+    assert lib.MXInvokeCachedOp(h_op, 1, ins, ctypes.byref(n_out),
+                                ctypes.byref(outs)) == 0, \
+        lib.MXGetLastError()
+    assert n_out.value == 1
+    got = np.zeros((2, 3), np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        outs[0], got.ctypes.data_as(ctypes.c_void_p), got.nbytes) == 0
+    assert np.allclose(got, 2 * vals + 1)
+    lib.MXFreeCachedOp(h_op)
+    lib.MXSymbolFree(h_sym)
