@@ -1,0 +1,96 @@
+"""Convergence gate for conv nets (reference: tests/python/train/
+test_conv.py — MNIST LeNet must reach 0.93 test accuracy).
+
+Real CIFAR-10 binaries are not present in this zero-egress environment
+(SCOPE.md §10): when `~/.mxnet/datasets/cifar10` holds the binary
+batches this gate trains ResNet on them (the chip path, results logged
+to PERF.md); otherwise it trains on a procedural 10-class image set
+whose classes are spatial patterns (oriented bars / checker scales /
+center blobs) — learnable only by actual convolutional feature
+learning, not color histograms.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _cifar_available():
+    root = os.path.expanduser("~/.mxnet/datasets/cifar10")
+    return any(os.path.exists(os.path.join(root, f))
+               for f in ("data_batch_1.bin", "cifar-10-binary.tar.gz"))
+
+
+def synth_images(rng, n, size=28):
+    """10 classes of rendered spatial patterns + noise."""
+    X = np.zeros((n, 1, size, size), "float32")
+    y = rng.randint(0, 10, n)
+    xs = np.arange(size)
+    for i in range(n):
+        c = y[i]
+        img = np.zeros((size, size), "float32")
+        if c < 4:                      # oriented bars, 4 angles
+            period = 6
+            ang = c * np.pi / 4
+            gx = np.cos(ang) * xs[None, :] + np.sin(ang) * xs[:, None]
+            img = (np.sin(2 * np.pi * gx / period) > 0).astype("float32")
+        elif c < 7:                    # checkerboard at 3 scales
+            k = [2, 4, 7][c - 4]
+            img = ((xs[None, :] // k + xs[:, None] // k) % 2
+                   ).astype("float32")
+        else:                          # blobs at 3 radii
+            r = [4, 8, 12][c - 7]
+            cx = rng.randint(size // 3, 2 * size // 3)
+            cy = rng.randint(size // 3, 2 * size // 3)
+            d2 = (xs[None, :] - cx) ** 2 + (xs[:, None] - cy) ** 2
+            img = (d2 < r * r).astype("float32")
+        shift = rng.randint(-3, 4, 2)
+        img = np.roll(np.roll(img, shift[0], 0), shift[1], 1)
+        X[i, 0] = img + rng.randn(size, size) * 0.3
+    return X, y.astype("float32")
+
+
+def small_cnn():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(2),
+                nn.Conv2D(32, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(2),
+                nn.GlobalAvgPool2D(), nn.Dense(10))
+    return net
+
+
+@pytest.mark.skipif(_cifar_available(), reason="real CIFAR present — "
+                    "run tools/train_gates.py for the full gate")
+def test_conv_net_converges_synthetic():
+    rng = np.random.RandomState(0)
+    Xtr, ytr = synth_images(rng, 3000)
+    Xte, yte = synth_images(rng, 600)
+    net = small_cnn()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xtr[:2]))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    B = 100
+    for epoch in range(6):
+        perm = rng.permutation(len(Xtr))
+        for b in range(len(Xtr) // B):
+            idx = perm[b * B:(b + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(B)
+    preds = []
+    for b in range(len(Xte) // B):
+        preds.append(net(nd.array(Xte[b * B:(b + 1) * B])
+                         ).asnumpy().argmax(1))
+    acc = (np.concatenate(preds) == yte[:len(preds) * B]).mean()
+    assert acc >= 0.90, "conv net failed the 0.90 gate: %.3f" % acc

@@ -1,0 +1,124 @@
+"""Chip-run convergence gates (reference: tests/python/train/).
+
+Run this manually in ONE process when a device window is open (never
+under `timeout` — see PERF.md §5 hazards):
+
+    python tools/train_gates.py            # both gates, JSON per line
+
+Gates:
+  conv: ResNet-style CNN to >=0.90 top-1. Uses real CIFAR-10 binaries
+        when ~/.mxnet/datasets/cifar10 has them; otherwise the
+        procedural pattern set from tests/train/test_conv_convergence
+        (SCOPE.md §10: this environment has zero egress, so the real
+        download never happens here — place the binaries to upgrade
+        the gate).
+  lstm: char LSTM on an order-2 Markov corpus; perplexity must close
+        >=55% of the unigram->floor gap and decrease every epoch.
+
+Record the printed JSON in PERF.md §7.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+
+def conv_gate():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from train.test_conv_convergence import (_cifar_available,
+                                             synth_images, small_cnn)
+
+    rng = np.random.RandomState(0)
+    if _cifar_available():
+        from mxnet_tpu.gluon.data.vision import CIFAR10
+        from mxnet_tpu.gluon.model_zoo import vision
+        tr, te = CIFAR10(train=True), CIFAR10(train=False)
+        Xtr = tr._data.transpose(0, 3, 1, 2).astype("float32") / 255.0
+        ytr = tr._label.astype("float32")
+        Xte = te._data.transpose(0, 3, 1, 2).astype("float32") / 255.0
+        yte = te._label.astype("float32")
+        net = vision.resnet18_v1(classes=10)
+        epochs, lr, tag = 30, 1e-3, "cifar10-resnet18"
+    else:
+        Xtr, ytr = synth_images(rng, 6000)
+        Xte, yte = synth_images(rng, 1000)
+        net = small_cnn()
+        epochs, lr, tag = 8, 2e-3, "synthetic-patterns"
+
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xtr[:2]))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    B = 128
+    t0 = time.time()
+    for epoch in range(epochs):
+        perm = rng.permutation(len(Xtr))
+        for b in range(len(Xtr) // B):
+            idx = perm[b * B:(b + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(B)
+    preds = []
+    for b in range(len(Xte) // B):
+        preds.append(net(nd.array(Xte[b * B:(b + 1) * B])
+                         ).asnumpy().argmax(1))
+    acc = float((np.concatenate(preds) == yte[:len(preds) * B]).mean())
+    return {"gate": "conv", "dataset": tag, "top1": round(acc, 4),
+            "wall_s": round(time.time() - t0, 1),
+            "passed": acc >= 0.90}
+
+
+def lstm_gate():
+    import numpy as np
+    from train import test_lstm_perplexity as tl
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    rng = np.random.RandomState(3)
+    corpus = tl.markov_corpus(rng, 120000)
+    val, train = corpus[-10000:], corpus[:-10000]
+    T, B = 16, 64
+    net = tl.CharLSTM()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((2, T), "float32")))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    n = (len(train) - 1) // T
+    x = train[:n * T].reshape(n, T).astype("float32")
+    t = train[1:n * T + 1].reshape(n, T).astype("float32")
+    t0 = time.time()
+    ppl = [tl._perplexity(net, val, T, B)]
+    for epoch in range(6):
+        perm = rng.permutation(n)
+        for b in range(n // B):
+            idx = perm[b * B:(b + 1) * B]
+            with autograd.record():
+                loss = loss_fn(net(nd.array(x[idx])), nd.array(t[idx]))
+            loss.backward()
+            trainer.step(B)
+        ppl.append(tl._perplexity(net, val, T, B))
+    closed = (ppl[0] - ppl[-1]) / (ppl[0] - 3.0)
+    return {"gate": "lstm", "ppl": [round(p, 2) for p in ppl],
+            "gap_closed": round(float(closed), 3),
+            "wall_s": round(time.time() - t0, 1),
+            "passed": bool(closed >= 0.55
+                           and all(b < a * 1.02
+                                   for a, b in zip(ppl, ppl[1:])))}
+
+
+if __name__ == "__main__":
+    for gate in (conv_gate, lstm_gate):
+        print(json.dumps(gate()), flush=True)
