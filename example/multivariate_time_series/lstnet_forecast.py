@@ -1,0 +1,105 @@
+"""Multivariate time-series forecasting (reference:
+example/multivariate_time_series — LSTNet on the electricity dataset).
+
+Proves multivariate sequence regression: a conv feature extractor over
+a sliding window + LSTM + dense head forecasts the next step of a
+coupled 8-channel oscillator system, beating the persistence baseline
+(predict last value) by a wide margin.
+
+Usage: python lstnet_forecast.py [--epochs 10] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+C = 8           # channels
+W = 24          # window
+
+
+def make_series(rng, n):
+    """Coupled noisy oscillators: each channel is a phase-shifted
+    mixture of two shared latent sine processes."""
+    t = np.arange(n + W + 1)
+    lat1 = np.sin(2 * np.pi * t / 17.0)
+    lat2 = np.sin(2 * np.pi * t / 5.0)
+    mix = rng.randn(2, C) * 0.8
+    series = (lat1[:, None] * mix[0] + lat2[:, None] * mix[1]
+              + rng.randn(len(t), C) * 0.05).astype("float32")
+    X = np.stack([series[i:i + W] for i in range(n)])          # (n,W,C)
+    Y = series[W:W + n]                                        # (n,C)
+    return X, Y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--train-size", type=int, default=4096)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(0)
+    X, Y = make_series(rng, args.train_size + 512)
+    Xtr, Ytr = X[:args.train_size], Y[:args.train_size]
+    Xte, Yte = X[args.train_size:], Y[args.train_size:]
+
+    class LSTNetLite(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv = nn.Conv1D(16, kernel_size=3, padding=1,
+                                      activation="relu")
+                self.lstm = gluon.rnn.LSTM(32, layout="NTC")
+                self.head = nn.Dense(C)
+
+        def hybrid_forward(self, F, x):
+            # (N, W, C) -> conv over time needs NCW
+            h = self.conv(F.transpose(x, axes=(0, 2, 1)))
+            h = self.lstm(F.transpose(h, axes=(0, 2, 1)))
+            return self.head(F.slice_axis(h, axis=1, begin=-1, end=None)
+                             .reshape((0, -1)))
+
+    net = LSTNetLite()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xtr[:2]))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.L2Loss()
+
+    B = args.batch
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        for b in range(len(Xtr) // B):
+            idx = perm[b * B:(b + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(Ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(B)
+            tot += float(nd.mean(loss).asnumpy())
+        print("epoch %2d loss %.5f" % (epoch, tot / (len(Xtr) // B)))
+
+    pred = net(nd.array(Xte)).asnumpy()
+    mse = float(np.mean((pred - Yte) ** 2))
+    persistence = float(np.mean((Xte[:, -1] - Yte) ** 2))
+    print("forecast mse %.5f vs persistence %.5f" % (mse, persistence))
+    assert mse < 0.3 * persistence, "forecaster no better than persistence"
+    print("FORECAST_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Variational autoencoder (reference: example/vae — MLP VAE on MNIST).
+
+Proves stochastic layers under autograd: the encoder emits (mu,
+log-var), the reparameterization draws eps through mx.random inside
+the recorded graph, and the loss is reconstruction + analytic KL. On
+synthetic 'digits' (shared class prototypes + noise, no dataset
+download). Success = ELBO improves AND the decoder reconstructs
+held-out samples better than the best constant predictor.
+
+Usage: python vae_mnist.py [--epochs 15] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+D = 64          # observation dim
+Z = 8           # latent dim
+
+
+def make_data(rng, protos, n, noise=0.25):
+    y = rng.randint(0, 10, n)
+    X = protos[y] + rng.randn(n, D).astype("float32") * noise
+    return 1.0 / (1.0 + np.exp(-X))          # squash into (0,1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--train-size", type=int, default=4096)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(0)
+    protos = rng.randn(10, D).astype("float32") * 2.0
+    Xtr = make_data(rng, protos, args.train_size)
+    Xte = make_data(rng, protos, 512)
+
+    class VAE(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.enc = nn.Dense(args.hidden, activation="relu")
+                self.mu = nn.Dense(Z)
+                self.logvar = nn.Dense(Z)
+                self.dec1 = nn.Dense(args.hidden, activation="relu")
+                self.dec2 = nn.Dense(D)
+
+        def hybrid_forward(self, F, x):
+            h = self.enc(x)
+            mu, logvar = self.mu(h), self.logvar(h)
+            eps = F.random.normal(shape=(x.shape[0], Z)) \
+                if hasattr(F, "random") else F.random_normal(
+                    shape=(x.shape[0], Z))
+            z = mu + F.exp(0.5 * logvar) * eps
+            logits = self.dec2(self.dec1(z))
+            return logits, mu, logvar
+
+    net = VAE()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    def elbo_terms(x):
+        logits, mu, logvar = net(x)
+        # bernoulli reconstruction via stable log-sigmoid forms
+        rec = nd.sum(nd.relu(logits) - logits * x +
+                     nd.log(1 + nd.exp(-nd.abs(logits))), axis=1)
+        kl = -0.5 * nd.sum(1 + logvar - mu * mu - nd.exp(logvar), axis=1)
+        return rec, kl
+
+    B = args.batch
+    first = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        for b in range(len(Xtr) // B):
+            x = nd.array(Xtr[perm[b * B:(b + 1) * B]])
+            with autograd.record():
+                rec, kl = elbo_terms(x)
+                loss = nd.mean(rec + kl)
+            loss.backward()
+            trainer.step(B)
+            tot += float(loss.asnumpy())
+        tot /= len(Xtr) // B
+        first = first if first is not None else tot
+        print("epoch %2d  -ELBO %.3f" % (epoch, tot))
+
+    # reconstruction error on held-out data vs best-constant baseline
+    logits, _, _ = net(nd.array(Xte))
+    recon = 1.0 / (1.0 + np.exp(-logits.asnumpy()))
+    mse = float(np.mean((recon - Xte) ** 2))
+    base = float(np.mean((Xte.mean(0, keepdims=True) - Xte) ** 2))
+    print("recon mse %.5f vs constant-baseline %.5f" % (mse, base))
+    assert mse < 0.5 * base, "VAE reconstructions no better than mean"
+    print("VAE_OK")
+
+
+if __name__ == "__main__":
+    main()

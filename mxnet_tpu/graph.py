@@ -376,6 +376,17 @@ def _regression_rule(ins, params, nodes):
     return out
 
 
+@register_shape_rule("SVMOutput")
+def _svm_out_rule(ins, params, nodes):
+    """label is one class index per row (reference: svm_output.cc)."""
+    data = ins[0]
+    if data is None or len(ins) < 2 or ins[1] is not None:
+        return ins
+    out = list(ins)
+    out[1] = _struct((data.shape[0],), jnp.float32)
+    return out
+
+
 for _n in ("LinearRegressionOutput", "MAERegressionOutput",
            "LogisticRegressionOutput"):
     _PARAM_SHAPE_RULES[_n] = _regression_rule

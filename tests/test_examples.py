@@ -122,3 +122,37 @@ def test_nce_toy():
 def test_multi_task():
     out = run_example("multi-task/multi_task.py", "--epochs", "6")
     assert "MULTI_TASK_OK" in out
+
+
+def test_bi_lstm_sort():
+    out = run_example("bi-lstm-sort/sort_lstm.py", "--epochs", "8",
+                      "--train-size", "2048", "--threshold", "0.75")
+    assert "BI_LSTM_SORT_OK" in out
+
+
+def test_vae():
+    out = run_example("vae/vae_mnist.py", "--epochs", "8")
+    assert "VAE_OK" in out
+
+
+def test_reinforce_gridworld():
+    out = run_example("reinforcement-learning/reinforce_gridworld.py",
+                      "--episodes", "300")
+    assert "REINFORCE_OK" in out
+
+
+def test_svm_classifier():
+    out = run_example("svm_mnist/svm_classifier.py", "--epochs", "8")
+    assert "SVM_OK" in out
+
+
+def test_multivariate_forecast():
+    out = run_example("multivariate_time_series/lstnet_forecast.py",
+                      "--epochs", "6", "--train-size", "2048")
+    assert "FORECAST_OK" in out
+
+
+def test_ner_tagger():
+    out = run_example("named_entity_recognition/ner_tagger.py",
+                      "--epochs", "8", "--train-size", "2048")
+    assert "NER_OK" in out
