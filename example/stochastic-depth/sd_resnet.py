@@ -1,0 +1,132 @@
+"""Stochastic depth (reference: example/stochastic-depth — residual
+blocks randomly skipped during training, kept at inference with
+survival-probability scaling).
+
+Proves mode-dependent stochastic architecture: each residual block
+draws a Bernoulli survival gate inside autograd.record() (training) but
+runs deterministically scaled at inference — the train/predict-mode
+plumbing the reference implements with mx.sym.uniform + custom blocks.
+
+Usage: python sd_resnet.py [--epochs 8] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_images(rng, n, size=16):
+    """10 classes of oriented-bar/checker/blob patterns (same family as
+    tests/train) at 16x16."""
+    X = np.zeros((n, 1, size, size), "float32")
+    y = rng.randint(0, 10, n)
+    xs = np.arange(size)
+    for i in range(n):
+        c = y[i]
+        if c < 4:
+            ang = c * np.pi / 4
+            g = np.cos(ang) * xs[None, :] + np.sin(ang) * xs[:, None]
+            img = (np.sin(2 * np.pi * g / 5) > 0).astype("float32")
+        elif c < 7:
+            k = [2, 3, 5][c - 4]
+            img = ((xs[None, :] // k + xs[:, None] // k) % 2
+                   ).astype("float32")
+        else:
+            r = [3, 5, 7][c - 7]
+            d2 = ((xs[None, :] - size // 2) ** 2
+                  + (xs[:, None] - size // 2) ** 2)
+            img = (d2 < r * r).astype("float32")
+        X[i, 0] = img + rng.randn(size, size) * 0.25
+    return X, y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--blocks", type=int, default=6)
+    ap.add_argument("--death-rate", type=float, default=0.3)
+    ap.add_argument("--train-size", type=int, default=3000)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    class SDBlock(gluon.Block):
+        """Residual block skipped with prob `death_rate` in training;
+        output scaled by survival prob at inference (reference
+        sd_module.py semantics). Uses Block (not Hybrid): the gate is
+        drawn per batch on the eager path."""
+
+        def __init__(self, channels, death_rate, **kw):
+            super().__init__(**kw)
+            self.death_rate = death_rate
+            with self.name_scope():
+                self.body = nn.Sequential()
+                self.body.add(nn.Conv2D(channels, 3, padding=1),
+                              nn.BatchNorm(),
+                              nn.Activation("relu"),
+                              nn.Conv2D(channels, 3, padding=1),
+                              nn.BatchNorm())
+
+        def forward(self, x):
+            if autograd.is_training():
+                if float(np.random.rand()) < self.death_rate:
+                    return x                  # block dies this batch
+                return nd.relu(x + self.body(x))
+            return nd.relu(x + (1 - self.death_rate) * self.body(x))
+
+    net = gluon.nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"))
+        for _ in range(args.blocks):
+            net.add(SDBlock(16, args.death_rate))
+        net.add(nn.GlobalAvgPool2D(), nn.Dense(10))
+
+    rng = np.random.RandomState(0)
+    Xtr, ytr = make_images(rng, args.train_size)
+    Xte, yte = make_images(rng, 600)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xtr[:2]))   # predict-mode pass runs EVERY block's body,
+    #                          materializing deferred shapes before any
+    #                          training batch can skip a dead block
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    B = args.batch
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        for b in range(len(Xtr) // B):
+            idx = perm[b * B:(b + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(B)
+            tot += float(nd.mean(loss).asnumpy())
+        print("epoch %2d loss %.4f" % (epoch, tot / (len(Xtr) // B)))
+
+    preds = []
+    for b in range(len(Xte) // B):
+        preds.append(net(nd.array(Xte[b * B:(b + 1) * B])
+                         ).asnumpy().argmax(1))
+    acc = (np.concatenate(preds) == yte[:len(preds) * B]).mean()
+    print("test accuracy: %.3f" % acc)
+    assert acc > 0.85, "stochastic-depth net failed to train"
+    print("STOCHASTIC_DEPTH_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,14 @@ def test_ner_tagger():
     out = run_example("named_entity_recognition/ner_tagger.py",
                       "--epochs", "8", "--train-size", "2048")
     assert "NER_OK" in out
+
+
+def test_fgsm_adversary():
+    out = run_example("adversary/fgsm.py", "--epochs", "5")
+    assert "FGSM_OK" in out
+
+
+def test_stochastic_depth():
+    out = run_example("stochastic-depth/sd_resnet.py", "--epochs", "6",
+                      "--train-size", "2000")
+    assert "STOCHASTIC_DEPTH_OK" in out
