@@ -1,0 +1,155 @@
+"""Capsule network with dynamic routing (reference: example/capsnet —
+CapsNet on MNIST, Sabour et al. routing-by-agreement).
+
+Proves an iterative routing algorithm running inside autograd: primary
+capsules come from a conv stem, digit capsules are computed by 3
+rounds of routing-by-agreement (softmax coupling over logits updated
+by prediction-output dot products), the class score is the capsule
+length, and the loss is the reference's margin loss. Runs on the
+procedural 10-class pattern set.
+
+Usage: python capsnet.py [--epochs 6] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+SIZE = 16
+N_CLASS = 10
+
+
+def make_images(rng, n):
+    X = np.zeros((n, 1, SIZE, SIZE), "float32")
+    y = rng.randint(0, N_CLASS, n)
+    xs = np.arange(SIZE)
+    for i in range(n):
+        c = y[i]
+        if c < 4:
+            ang = c * np.pi / 4
+            g = np.cos(ang) * xs[None, :] + np.sin(ang) * xs[:, None]
+            img = (np.sin(2 * np.pi * g / 5) > 0).astype("float32")
+        elif c < 7:
+            k = [2, 3, 5][c - 4]
+            img = ((xs[None, :] // k + xs[:, None] // k) % 2
+                   ).astype("float32")
+        else:
+            r = [3, 5, 7][c - 7]
+            d2 = ((xs[None, :] - SIZE // 2) ** 2
+                  + (xs[:, None] - SIZE // 2) ** 2)
+            img = (d2 < r * r).astype("float32")
+        X[i, 0] = img + rng.randn(SIZE, SIZE) * 0.2
+    return X, y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch", type=int, default=50)
+    ap.add_argument("--routing", type=int, default=3)
+    ap.add_argument("--train-size", type=int, default=2000)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    D_PRIM, D_DIGIT = 4, 8
+    N_PRIM_CH = 4
+
+    class CapsNet(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                # BN keeps capsule magnitudes O(1): squash(s) ~ s|s| for
+                # small s, so unnormalized stacks vanish to zero output
+                self.stem = nn.Sequential()
+                self.stem.add(nn.Conv2D(16, 5, strides=2, padding=2),
+                              nn.BatchNorm(), nn.Activation("relu"))
+                self.prim = nn.Sequential()
+                self.prim.add(nn.Conv2D(N_PRIM_CH * D_PRIM, 3, strides=2,
+                                        padding=1), nn.BatchNorm())
+                # transform u_i -> u_hat_{j|i}, one matrix per (i-type, j)
+                self.W = self.params.get(
+                    "routing_weight",
+                    shape=(1, N_PRIM_CH * 4 * 4, N_CLASS, D_DIGIT,
+                           D_PRIM),
+                    init=mx.init.Xavier())
+
+        @staticmethod
+        def squash(s, axis):
+            n2 = nd.sum(s * s, axis=axis, keepdims=True)
+            return s * (n2 / (1 + n2)) / nd.sqrt(n2 + 1e-8)
+
+        def forward(self, x):
+            b = x.shape[0]
+            h = self.prim(self.stem(x))          # (B, C*Dp, 4, 4)
+            u = h.reshape((b, N_PRIM_CH, D_PRIM, -1))
+            u = nd.transpose(u, axes=(0, 1, 3, 2)).reshape(
+                (b, -1, D_PRIM))
+            u = self.squash(u, axis=2)           # (B, P, Dp)
+            W = self.W.data()                    # (1, P, J, Dd, Dp)
+            # u_hat[b,p,j,:] = W[p,j] @ u[b,p]
+            u_ = u.expand_dims(2).expand_dims(-1)       # (B,P,1,Dp,1)
+            u_hat = nd.sum(W * nd.transpose(u_, axes=(0, 1, 2, 4, 3)),
+                           axis=-1)                      # (B,P,J,Dd)
+            # routing-by-agreement (logits held out of the grad path,
+            # as in the reference implementation)
+            logits = nd.zeros((b, u_hat.shape[1], N_CLASS))
+            for it in range(args.routing):
+                c = nd.softmax(logits, axis=2)           # (B,P,J)
+                s = nd.sum(c.expand_dims(-1) * u_hat, axis=1)  # (B,J,Dd)
+                v = self.squash(s, axis=2)
+                if it < args.routing - 1:
+                    agree = nd.sum(u_hat * v.expand_dims(1), axis=-1)
+                    logits = logits + agree.detach()
+            return nd.sqrt(nd.sum(v * v, axis=2) + 1e-8)   # (B, J)
+
+    def margin_loss(lengths, y):
+        oh = nd.one_hot(y, depth=N_CLASS)
+        pos = nd.relu(0.9 - lengths) ** 2
+        neg = nd.relu(lengths - 0.1) ** 2
+        return nd.mean(nd.sum(oh * pos + 0.5 * (1 - oh) * neg, axis=1))
+
+    rng = np.random.RandomState(0)
+    Xtr, ytr = make_images(rng, args.train_size)
+    Xte, yte = make_images(rng, 500)
+    net = CapsNet()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    B = args.batch
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        for b in range(len(Xtr) // B):
+            idx = perm[b * B:(b + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = margin_loss(net(x), y)
+            loss.backward()
+            trainer.step(B)
+            tot += float(loss.asnumpy())
+        print("epoch %2d margin loss %.4f" % (epoch, tot / (len(Xtr) // B)))
+
+    preds = []
+    for b in range(len(Xte) // B):
+        preds.append(net(nd.array(Xte[b * B:(b + 1) * B])
+                         ).asnumpy().argmax(1))
+    acc = (np.concatenate(preds) == yte[:len(preds) * B]).mean()
+    print("test accuracy: %.3f" % acc)
+    assert acc > 0.85, "capsnet failed to train"
+    print("CAPSNET_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -167,3 +167,16 @@ def test_stochastic_depth():
     out = run_example("stochastic-depth/sd_resnet.py", "--epochs", "6",
                       "--train-size", "2000")
     assert "STOCHASTIC_DEPTH_OK" in out
+
+
+def test_speech_recognition():
+    out = run_example("speech_recognition/deepspeech_lite.py",
+                      "--epochs", "5", "--train-size", "256",
+                      "--loss-only", timeout=540)
+    assert "SPEECH_OK" in out
+
+
+def test_capsnet():
+    out = run_example("capsnet/capsnet.py", "--epochs", "4",
+                      "--train-size", "1500", timeout=540)
+    assert "CAPSNET_OK" in out
