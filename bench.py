@@ -58,8 +58,10 @@ def _probe_devices(timeout_s=180):
     """
     import subprocess
     import sys
-    retries = int(os.environ.get("MXTPU_BENCH_PROBE_RETRIES", 3))
-    waits = (45, 90, 180)
+    # 6 probes spanning ~35 min by default: relay-lease wedges clear
+    # with time (round 4 evidence), so a short probe burst undersamples
+    retries = int(os.environ.get("MXTPU_BENCH_PROBE_RETRIES", 6))
+    waits = (60, 120, 240, 480, 600, 600)
     plat = os.environ.get("MXTPU_BENCH_PLATFORM")
     pin = ("import jax; jax.config.update('jax_platforms', %r); " % plat
            if plat else "")
@@ -104,6 +106,16 @@ def _probe_devices(timeout_s=180):
         for line in (ks.stdout + ks.stderr).splitlines():
             sys.stderr.write("bench:   kill_stale: %s\n" % line)
         time.sleep(waits[min(attempt, len(waits) - 1)])
+    # attach environment diagnostics to the failure record so the
+    # post-mortem does not need a live session
+    try:
+        dg = subprocess.run([sys.executable,
+                             os.path.join(here, "tools", "diagnose.py")],
+                            capture_output=True, text=True, timeout=120)
+        for line in (dg.stdout + dg.stderr).splitlines()[-15:]:
+            sys.stderr.write("bench:   diagnose: %s\n" % line)
+    except Exception as e:  # diagnostics must never mask the verdict
+        sys.stderr.write("bench:   diagnose failed: %s\n" % e)
     raise SystemExit("bench: device backend unreachable after %d probes "
                      "(%s)" % (max(retries, 1), err))
 
