@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover
 from .registry import register
 
 __all__ = ["flash_attention", "pallas_layer_norm",
-           "fused_sgd_momentum"]
+           "fused_sgd_momentum", "conv1x1_bn_stats"]
 
 _NEG_INF = -1e30
 
@@ -269,3 +269,69 @@ def fused_sgd_momentum(w, g, m, lr, momentum=0.9, wd=0.0, rescale=1.0,
         return ow, om
     unpad = lambda x: x.reshape(-1)[:n].reshape(orig_shape)  # noqa: E731
     return unpad(ow), unpad(om)
+
+
+# ---------------------------------------------------------------------------
+# 1x1-conv + BN-statistics epilogue fusion
+# ---------------------------------------------------------------------------
+def _conv1x1_bn_kernel(x_ref, w_ref, y_ref, s_ref, ss_ref):
+    i = pl.program_id(0)
+    y = jnp.dot(x_ref[:].astype(jnp.float32),
+                w_ref[:].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        s_ref[:] = jnp.zeros_like(s_ref)
+        ss_ref[:] = jnp.zeros_like(ss_ref)
+
+    # TPU grids run sequentially, so read-modify-write accumulation
+    # across grid steps is well-defined (same contract the guide's
+    # reduction pattern relies on)
+    s_ref[:] += jnp.sum(y, axis=0)
+    ss_ref[:] += jnp.sum(y * y, axis=0)
+
+
+def conv1x1_bn_stats(x, w, block_rows=256):
+    """y = x @ w with the BN batch statistics accumulated in the SAME
+    kernel (per-channel sum / sum-of-squares as each output block is
+    produced), so the statistics pass costs zero extra HBM reads of y.
+
+    This is the VERDICT-r4 'BN-stat fusion into the producer epilogue'
+    prototype: the profiler trace pinned convert_reduce_fusion (BN
+    stats, a full re-read of every conv output) at ~5 ms/step of the
+    46 ms ResNet-50 step. 1x1 convs — the majority of ResNet-50's
+    layers — ARE matmuls, so their epilogue is ours to own.
+
+    x: (M, Cin) row-major activations (N*H*W flattened), w: (Cin, Cout).
+    Returns (y, mean, var) with fp32 statistics. Numerics: stats use the
+    single-pass E[x^2]-E[x]^2 form, matching ops/nn.py's BatchNorm.
+    Measured on-chip by tools/mfu_probe.py (stage 'bn_fusion'); wire
+    into the conv path only if it beats the XLA schedule there.
+    """
+    M, Cin = x.shape
+    Cout = w.shape[1]
+    br = min(block_rows, M)
+    pad = (-M) % br
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    blocks = xp.shape[0] // br
+    y, s, ss = pl.pallas_call(
+        _conv1x1_bn_kernel,
+        out_shape=(jax.ShapeDtypeStruct(xp.shape[:1] + (Cout,), x.dtype),
+                   jax.ShapeDtypeStruct((Cout,), jnp.float32),
+                   jax.ShapeDtypeStruct((Cout,), jnp.float32)),
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((br, Cin), lambda i: (i, 0)),
+                  pl.BlockSpec((Cin, Cout), lambda i: (0, 0))],
+        out_specs=(pl.BlockSpec((br, Cout), lambda i: (i, 0)),
+                   pl.BlockSpec((Cout,), lambda i: (0,)),
+                   pl.BlockSpec((Cout,), lambda i: (0,))),
+        interpret=_interpret(),
+    )(xp, w)
+    if pad:
+        y = y[:M]
+        # padded rows contribute zeros to s and ss — correct the count
+    mean = s / M
+    var = jnp.maximum(ss / M - mean * mean, 0.0)
+    return y, mean, var

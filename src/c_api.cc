@@ -565,6 +565,77 @@ int MXTrainerSaveParams(TrainerHandle handle, const char *path) {
 int MXTrainerFree(TrainerHandle handle) { return MXSymbolFree(handle); }
 
 // ---------------------------------------------------------------------
+// KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull — the
+// parameter-exchange surface; SURVEY N9)
+// ---------------------------------------------------------------------
+typedef void *KVStoreHandle;
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *args = Py_BuildValue("(s)", type);
+  PyObject *kv = call_expr("lambda t: mxnet_tpu.kvstore.create(t)", args);
+  Py_XDECREF(args);
+  if (kv) {
+    *out = new PyHandle{kv, {}, {}};
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) { return MXSymbolFree(handle); }
+
+static int kv_op(KVStoreHandle handle, const char *method, int key,
+                 NDArrayHandle value) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  PyObject *args = Py_BuildValue(
+      "(OsiO)", h->obj, method, key,
+      static_cast<Handle *>(value)->obj);
+  PyObject *r = call_expr(
+      "lambda kv, m, k, v: getattr(kv, m)(k, v)", args);
+  Py_XDECREF(args);
+  int rc = r ? 0 : (set_py_error(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, int key, NDArrayHandle value) {
+  return kv_op(handle, "init", key, value);
+}
+
+int MXKVStorePush(KVStoreHandle handle, int key, NDArrayHandle value) {
+  return kv_op(handle, "push", key, value);
+}
+
+// pull ADDS INTO the caller's array semantics-wise overwrite: the
+// python pull(out=...) writes the aggregated value into `out`
+int MXKVStorePull(KVStoreHandle handle, int key, NDArrayHandle out) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyHandle *h = static_cast<PyHandle *>(handle);
+  Handle *o = static_cast<Handle *>(out);
+  PyObject *args = Py_BuildValue("(OiO)", h->obj, key, o->obj);
+  PyObject *r = call_expr(
+      "lambda kv, k, out: kv.pull(k, out=out)", args);
+  Py_XDECREF(args);
+  int rc = -1;
+  if (r) {
+    refresh_shape(o);
+    rc = 0;
+  } else {
+    set_py_error();
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+// ---------------------------------------------------------------------
 // predict API (reference: amalgamation/c_predict_api.h — the shape of
 // every C deployment of the reference)
 // ---------------------------------------------------------------------

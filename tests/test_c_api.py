@@ -386,3 +386,47 @@ def test_cached_op_c_abi():
     assert np.allclose(got, 2 * vals + 1)
     lib.MXFreeCachedOp(h_op)
     lib.MXSymbolFree(h_sym)
+
+
+def test_kvstore_c_abi():
+    """MXKVStore create/init/push/pull through ctypes — the parameter
+    exchange a C host drives (reference: c_api.h KVStore surface)."""
+    lib = _lib()
+    lib.MXKVStoreCreate.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXKVStoreInit.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    lib.MXKVStorePush.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    lib.MXKVStorePull.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    lib.MXKVStoreFree.argtypes = [ctypes.c_void_p]
+
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0, \
+        lib.MXGetLastError()
+
+    def mk(vals):
+        shape = (ctypes.c_int64 * 1)(len(vals))
+        h = ctypes.c_void_p()
+        assert lib.MXNDArrayCreate(shape, 1, 0, ctypes.byref(h)) == 0
+        a = np.asarray(vals, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes) == 0
+        return h
+
+    assert lib.MXKVStoreInit(kv, 3, mk([0.0, 0.0, 0.0])) == 0, \
+        lib.MXGetLastError()
+    # push stores the merged value (reference kvstore_local PushImpl);
+    # a second push overwrites
+    assert lib.MXKVStorePush(kv, 3, mk([1.0, 2.0, 3.0])) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXKVStorePush(kv, 3, mk([10.0, 20.0, 30.0])) == 0
+
+    out = mk([0.0, 0.0, 0.0])
+    assert lib.MXKVStorePull(kv, 3, out) == 0, lib.MXGetLastError()
+    got = np.zeros(3, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        out, got.ctypes.data_as(ctypes.c_void_p), got.nbytes) == 0
+    assert np.allclose(got, [10.0, 20.0, 30.0]), got
+    lib.MXKVStoreFree(kv)

@@ -96,3 +96,18 @@ def test_fused_sgd_momentum_mixed_dtype():
     assert ow.dtype == jnp.bfloat16 and om.dtype == jnp.float32
     m_ref = 0.9 * m + np.asarray(jnp.asarray(g, jnp.bfloat16), "float32")
     assert np.allclose(np.asarray(om), m_ref, atol=2e-2)
+
+
+def test_conv1x1_bn_stats_fusion():
+    """Fused matmul+BN-stat epilogue matches the two-pass oracle,
+    including the padded-rows path."""
+    from mxnet_tpu.ops.pallas_kernels import conv1x1_bn_stats
+    rng = np.random.RandomState(0)
+    for M, Cin, Cout in [(512, 16, 32), (300, 8, 8)]:   # 300: pad path
+        x = jnp.asarray(rng.randn(M, Cin), jnp.float32)
+        w = jnp.asarray(rng.randn(Cin, Cout) * 0.2, jnp.float32)
+        y, mean, var = conv1x1_bn_stats(x, w, block_rows=128)
+        ref = np.asarray(x) @ np.asarray(w)
+        assert np.allclose(np.asarray(y), ref, atol=1e-4)
+        assert np.allclose(np.asarray(mean), ref.mean(0), atol=1e-4)
+        assert np.allclose(np.asarray(var), ref.var(0), atol=1e-3)

@@ -93,6 +93,48 @@ def update_roofline():
             "note": "3R+2W bytes/iter; v5e HBM spec ~819 GB/s"}
 
 
+def bn_fusion_probe():
+    """Fused 1x1-conv + BN-stat epilogue vs the XLA two-pass schedule,
+    at a representative ResNet-50 interior shape (56x56, C=64->256,
+    b128 -> M=401408 rows). Keep the kernel only if pallas wins here
+    (VERDICT r4 #5c)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import conv1x1_bn_stats
+
+    M, Cin, Cout = 128 * 56 * 56, 64, 256
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(M, Cin).astype("float32"))
+    w = jax.device_put((rng.randn(Cin, Cout) * 0.1).astype("float32"))
+    iters = 30
+
+    def xla_version(x, w):
+        y = x @ w
+        mean = jnp.mean(y, axis=0)
+        var = jnp.mean(y * y, axis=0) - mean * mean
+        return y, mean, var
+
+    def timed(fn):
+        @jax.jit
+        def loop(x, w):
+            def body(i, c):
+                y, mean, var = fn(x, w + 0.0 * i)
+                return (y[:1, :1] + mean[:1] + var[:1],)
+            return jax.lax.fori_loop(0, iters, body,
+                                     (jnp.zeros((1, 1)),))
+        np.asarray(jax.device_get(loop(x, w)[0]))
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(loop(x, w)[0]))
+        dt = time.perf_counter() - t0
+        return dt / iters * 1e3
+
+    xla_ms = timed(xla_version)
+    pallas_ms = timed(lambda x, w: conv1x1_bn_stats(x, w))
+    return {"xla_ms": round(xla_ms, 3), "pallas_ms": round(pallas_ms, 3),
+            "shape": "M=%d Cin=%d Cout=%d" % (M, Cin, Cout),
+            "winner": "pallas" if pallas_ms < xla_ms else "xla"}
+
+
 def main():
     from mxnet_tpu.base import probe_devices
     devs, err = probe_devices(timeout_s=240)
@@ -114,6 +156,7 @@ def main():
         "with ONE real chip dp=1 so there is nothing to shard — "
         "a single-chip b256 memory fix must come from remat instead")
     _record("update_roofline", update_roofline)
+    _record("bn_fusion", bn_fusion_probe)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                        "PROBE_MFU.json")
