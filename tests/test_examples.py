@@ -180,3 +180,8 @@ def test_capsnet():
     out = run_example("capsnet/capsnet.py", "--epochs", "4",
                       "--train-size", "1500", timeout=540)
     assert "CAPSNET_OK" in out
+
+
+def test_wgan_gradient_penalty():
+    out = run_example("gradient_penalty/wgan_gp.py", "--steps", "120")
+    assert "WGAN_GP_OK" in out
