@@ -1,0 +1,116 @@
+"""URI-aware file access (reference role: dmlc-core's filesystem layer
+— src/io/{local_filesys,s3_filesys,hdfs_filesys}.cc behind
+dmlc::Stream::Create, SURVEY N17).
+
+The reference routes every data path through a URI-dispatching stream
+factory so `s3://bucket/key` works anywhere a local path does. Same
+contract here, sized to this stack:
+
+- local paths and `file://` open directly;
+- `s3://` opens through boto3 when it is importable (it is not baked
+  into this image) — the call shape matches the reference's
+  environment-variable credential convention (AWS_ACCESS_KEY_ID /
+  AWS_SECRET_ACCESS_KEY / S3_ENDPOINT);
+- `hdfs://` has no client in this environment and raises with
+  guidance (the reference needs libhdfs present at build time for the
+  same reason).
+
+RecordIO readers/writers (recordio.py, io_record.py) accept anything
+`open_uri` accepts.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+from .base import MXNetError
+
+__all__ = ["open_uri", "exists", "scheme_of"]
+
+
+def scheme_of(uri):
+    """'s3' for s3://..., 'file' for file://..., '' for plain paths."""
+    if "://" not in str(uri):
+        return ""
+    return str(uri).split("://", 1)[0].lower()
+
+
+def _strip_file(uri):
+    s = str(uri)
+    return s[len("file://"):] if s.startswith("file://") else s
+
+
+def _s3_parts(uri):
+    rest = str(uri)[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    if not bucket or not key:
+        raise MXNetError("malformed S3 uri %r (want s3://bucket/key)" % uri)
+    return bucket, key
+
+
+def _s3_client():
+    try:
+        import boto3
+    except ImportError:
+        raise MXNetError(
+            "s3:// paths need boto3, which is not installed in this "
+            "environment; stage the file locally (or install boto3 — "
+            "credentials follow the usual AWS_ACCESS_KEY_ID/"
+            "AWS_SECRET_ACCESS_KEY/S3_ENDPOINT variables, the "
+            "reference's s3_filesys.cc convention)")
+    endpoint = os.environ.get("S3_ENDPOINT")
+    return boto3.client("s3", endpoint_url=endpoint)
+
+
+def open_uri(uri, mode="rb"):
+    """Open a local path, file://, or s3:// uri. Returns a file-like
+    object; s3 reads are fully buffered (RecordIO wants seekable), s3
+    writes upload on close."""
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        return open(_strip_file(uri), mode)
+    if scheme == "s3":
+        client = _s3_client()
+        bucket, key = _s3_parts(uri)
+        if "r" in mode:
+            body = client.get_object(Bucket=bucket, Key=key)["Body"].read()
+            return io.BytesIO(body)
+        if "w" in mode:
+            return _S3WriteBuffer(client, bucket, key)
+        raise MXNetError("s3 open mode %r not supported" % mode)
+    if scheme == "hdfs":
+        raise MXNetError(
+            "hdfs:// is not available in this environment (no libhdfs); "
+            "stage the file locally — the reference has the same "
+            "build-time requirement (dmlc USE_HDFS=1)")
+    raise MXNetError("unsupported uri scheme %r in %r" % (scheme, uri))
+
+
+class _S3WriteBuffer(io.BytesIO):
+    def __init__(self, client, bucket, key):
+        super().__init__()
+        self._dest = (client, bucket, key)
+        self._closed_once = False
+
+    def close(self):
+        if not self._closed_once:
+            self._closed_once = True
+            client, bucket, key = self._dest
+            client.put_object(Bucket=bucket, Key=key,
+                              Body=self.getvalue())
+        super().close()
+
+
+def exists(uri):
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        return os.path.exists(_strip_file(uri))
+    if scheme == "s3":
+        client = _s3_client()
+        bucket, key = _s3_parts(uri)
+        try:
+            client.head_object(Bucket=bucket, Key=key)
+            return True
+        except Exception:
+            return False
+    raise MXNetError("unsupported uri scheme %r in %r" % (scheme, uri))

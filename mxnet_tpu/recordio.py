@@ -50,21 +50,27 @@ class MXRecordIO:
         self.open()
 
     def open(self):
-        lib = _native_lib()
+        from .filesystem import open_uri, scheme_of, _strip_file
+        # the C++ reader takes local paths; remote uris go through the
+        # filesystem layer's buffered python path (dmlc Stream::Create
+        # dispatch, SURVEY N17)
+        local = scheme_of(self.uri) in ("", "file")
+        path = _strip_file(self.uri) if local else self.uri
+        lib = _native_lib() if local else None
         if self.flag == "w":
             self.writable = True
             if lib is not None:
                 from . import _native
-                self._nat = _native.RecordWriter(self.uri)
+                self._nat = _native.RecordWriter(path)
             else:
-                self.handle = open(self.uri, "wb")
+                self.handle = open_uri(self.uri, "wb")
         elif self.flag == "r":
             self.writable = False
             if lib is not None:
                 from . import _native
-                self._nat = _native.RecordReader(self.uri)
+                self._nat = _native.RecordReader(path)
             else:
-                self.handle = open(self.uri, "rb")
+                self.handle = open_uri(self.uri, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True

@@ -150,3 +150,34 @@ def test_callbacks_behavior(caplog, capsys):
     for e in range(4):
         cb(e)
     assert saved == [2, 4]
+
+
+def test_filesystem_uri_layer(tmp_path):
+    """dmlc-filesystem role (SURVEY N17): URI dispatch for data paths."""
+    from mxnet_tpu import filesystem as fs
+    import mxnet_tpu as mx
+    import pytest
+
+    p = tmp_path / "x.bin"
+    with fs.open_uri(str(p), "wb") as f:
+        f.write(b"abc")
+    assert fs.exists("file://" + str(p))
+    with fs.open_uri("file://" + str(p), "rb") as f:
+        assert f.read() == b"abc"
+    with pytest.raises(mx.MXNetError, match="boto3"):
+        fs.open_uri("s3://bucket/key")
+    with pytest.raises(mx.MXNetError, match="hdfs"):
+        fs.open_uri("hdfs://nn/path")
+    with pytest.raises(mx.MXNetError, match="scheme"):
+        fs.open_uri("gopher://x/y")
+
+    # recordio round-trips through a file:// uri (python fallback path)
+    from mxnet_tpu import recordio
+    rec = tmp_path / "data.rec"
+    w = recordio.MXRecordIO("file://" + str(rec), "w")
+    w.write(b"hello")
+    w.write(b"world")
+    w.close()
+    r = recordio.MXRecordIO("file://" + str(rec), "r")
+    assert r.read() == b"hello" and r.read() == b"world"
+    r.close()
