@@ -44,9 +44,20 @@ def main():
         print("using real CIFAR-10")
     except RuntimeError:
         print("CIFAR-10 not found; synthetic data")
+        # learnable stand-in: class = (spatial pattern, color channel)
         rng = np.random.RandomState(0)
-        x = rng.rand(1024, 3, 32, 32).astype("float32")
-        y = rng.randint(0, 10, 1024).astype("float32")
+        n = 2048
+        y = rng.randint(0, 10, n)
+        x = np.zeros((n, 3, 32, 32), "float32")
+        xs = np.arange(32)
+        for i in range(n):
+            c = y[i]
+            ang = (c % 5) * np.pi / 5
+            g = np.cos(ang) * xs[None, :] + np.sin(ang) * xs[:, None]
+            pat = (np.sin(2 * np.pi * g / 6) > 0).astype("float32")
+            x[i, c // 5] = pat
+            x[i] += rng.randn(3, 32, 32) * 0.15
+        y = y.astype("float32")
 
     loader = DataLoader(ArrayDataset(x.astype("float32"),
                                      y.astype("float32")),
@@ -73,8 +84,13 @@ def main():
             pred = out.argmax(axis=1).asnumpy()
             correct += (pred == yb.asnumpy()).sum()
             total += xb.shape[0]
-        print("epoch %d loss %.4f acc %.3f"
-              % (epoch, lsum / n, correct / total))
+        acc = correct / total
+        print("epoch %d loss %.4f acc %.3f" % (epoch, lsum / n, acc))
+        if epoch == 0:
+            first_acc = acc
+    assert acc >= first_acc and acc > 0.25, \
+        "no learning signal: acc %.3f (epoch0 %.3f)" % (acc, first_acc)
+    print("CIFAR_EXAMPLE_OK")
 
 
 if __name__ == "__main__":

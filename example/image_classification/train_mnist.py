@@ -47,8 +47,31 @@ def get_data(args):
         print("MNIST files not found; using synthetic data "
               "(--benchmark mode)")
         rng = np.random.RandomState(0)
-        xt = rng.rand(2000, 1, 28, 28).astype("float32")
-        yt = rng.randint(0, 10, 2000).astype("float32")
+        # learnable stand-in: 10 spatial pattern classes (bars /
+        # checkers / blobs), shift-jittered + noise
+        n = 2400
+        yt = rng.randint(0, 10, n)
+        xt = np.zeros((n, 1, 28, 28), "float32")
+        xs = np.arange(28)
+        for i in range(n):
+            c = int(yt[i])
+            if c < 4:
+                ang = c * np.pi / 4
+                g = np.cos(ang) * xs[None, :] + np.sin(ang) * xs[:, None]
+                img = (np.sin(2 * np.pi * g / 6) > 0).astype("float32")
+            elif c < 7:
+                k = [2, 4, 7][c - 4]
+                img = ((xs[None, :] // k + xs[:, None] // k) % 2
+                       ).astype("float32")
+            else:
+                r = [4, 8, 12][c - 7]
+                cx, cy = rng.randint(9, 19, 2)
+                d2 = (xs[None, :] - cx) ** 2 + (xs[:, None] - cy) ** 2
+                img = (d2 < r * r).astype("float32")
+            sh = rng.randint(-3, 4, 2)
+            img = np.roll(np.roll(img, sh[0], 0), sh[1], 1)
+            xt[i, 0] = img + rng.randn(28, 28) * 0.25
+        yt = yt.astype("float32")
         xv, yv = xt[:500], yt[:500]
     train_iter = mx.io.NDArrayIter(xt.astype("float32"), yt,
                                    args.batch_size, shuffle=True)
@@ -59,9 +82,9 @@ def get_data(args):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--epochs", type=int, default=6)
     p.add_argument("--batch-size", type=int, default=64)
-    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--data-dir",
                    default=os.path.join("~", ".mxnet", "datasets",
                                         "mnist"))
@@ -82,7 +105,10 @@ def main():
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
             batch_end_callback=mx.callback.Speedometer(args.batch_size,
                                                        20))
-    print("final accuracy:", mod.score(val_iter, "acc"))
+    score = dict(mod.score(val_iter, "acc"))
+    print("final accuracy:", score)
+    assert score["accuracy"] > 0.8, "LeNet failed to learn: %s" % score
+    print("MNIST_EXAMPLE_OK")
 
 
 if __name__ == "__main__":

@@ -107,6 +107,11 @@ def main():
             n += 1
         ppl = float(np.exp(total / n))
         print("epoch %d loss %.3f ppl %.2f" % (epoch, total / n, ppl))
+        if epoch == 0:
+            first_ppl = ppl
+    assert ppl < first_ppl, \
+        "perplexity did not improve: %.2f -> %.2f" % (first_ppl, ppl)
+    print("WORD_LM_OK")
 
 
 if __name__ == "__main__":

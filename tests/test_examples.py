@@ -77,7 +77,7 @@ def test_sharded_resnet_example():
 
 
 def test_gluon_cifar10_example():
-    out = run_example("gluon/train_cifar10.py", "--epochs", "1")
+    out = run_example("gluon/train_cifar10.py", "--epochs", "2")
     assert "epoch 0" in out
 
 
@@ -185,3 +185,14 @@ def test_capsnet():
 def test_wgan_gradient_penalty():
     out = run_example("gradient_penalty/wgan_gp.py", "--steps", "120")
     assert "WGAN_GP_OK" in out
+
+
+def test_word_lm():
+    out = run_example("rnn/word_lm.py", "--epochs", "2")
+    assert "WORD_LM_OK" in out
+
+
+def test_mnist_module_fit():
+    out = run_example("image_classification/train_mnist.py",
+                      "--epochs", "6")
+    assert "MNIST_EXAMPLE_OK" in out
