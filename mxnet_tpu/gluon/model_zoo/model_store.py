@@ -1,34 +1,140 @@
 """Pretrained model weight store.
 
 Reference: python/mxnet/gluon/model_zoo/model_store.py (get_model_file,
-purge). The reference downloads sha1-pinned .params from S3; this
-environment has no egress, so get_model_file only resolves files already
-present under `root` (same `<name>-<sha1[:8]>.params` or `<name>.params`
-naming), raising a clear error otherwise.
+purge): sha1-pinned .params zips downloaded from the Apache repo into
+`~/.mxnet/models`. Same contract here — the checkpoints are the
+reference's own (our `.params` codec is byte-compatible, so the
+published weights load directly). In an egress-less environment the
+download step fails with an actionable error and pre-placed files are
+used; sha1 pinning verifies either path.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import zipfile
 
 __all__ = ["get_model_file", "purge"]
 
+# sha1 -> name pins for the published checkpoints this zoo can host
+# (reference model_store.py:27; the hashes are behavioral constants of
+# the published artifacts)
+_MODEL_SHA1 = {name: checksum for checksum, name in [
+    ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+    ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+    ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+    ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+    ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+    ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+    ("9f83e440996887baf91a6aff1cccc1c903a64274", "mobilenet0.25"),
+    ("8e9d539cc66aa5efa71c4b6af983b936ab8701c3", "mobilenet0.5"),
+    ("529b2c7f4934e6cb851155b22c96c9ab0a7c4dc2", "mobilenet0.75"),
+    ("6b8c5106c730e8750bcd82ceb75220a3351157cd", "mobilenet1.0"),
+    ("a0666292f0a30ff61f857b0b66efc0228eb6a54b", "resnet18_v1"),
+    ("48216ba99a8b1005d75c0f3a0c422301a0473233", "resnet34_v1"),
+    ("0aee57f96768c0a2d5b23a6ec91eb08dfb0a45ce", "resnet50_v1"),
+    ("d988c13d6159779e907140a638c56f229634cb02", "resnet101_v1"),
+    ("671c637a14387ab9e2654eafd0d493d86b1c8579", "resnet152_v1"),
+    ("a81db45fd7b7a2d12ab97cd88ef0a5ac48b8f657", "resnet18_v2"),
+    ("9d6b80bbc35169de6b6edecffdd6047c56fdd322", "resnet34_v2"),
+    ("ecdde35339c1aadbec4f547857078e734a76fb49", "resnet50_v2"),
+    ("18e93e4f48947e002547f50eabbcc9c83e516aa6", "resnet101_v2"),
+    ("f2695542de38cf7e71ed58f02893d82bb409415e", "resnet152_v2"),
+    ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+    ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+    ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+    ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+    ("6bc5de58a05a5e2e7f493e2d75a580d83efde38c", "vgg13"),
+    ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+    ("e660d4569ccb679ec68f1fd3cce07a387252a90a", "vgg16"),
+    ("7f01cf050d357127a73826045c245041b0df7363", "vgg16_bn"),
+    ("ad2f660d101905472b83590b59708b71ea22b2e5", "vgg19"),
+]}
+
+_DEFAULT_REPO = ("https://apache-mxnet.s3-accelerate.dualstack."
+                 "amazonaws.com/")
+
+
+def _sha1_of(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _short_hash(name):
+    if name not in _MODEL_SHA1:
+        raise ValueError(
+            "no pretrained checkpoint is published for %r (known: %s)"
+            % (name, ", ".join(sorted(_MODEL_SHA1))))
+    return _MODEL_SHA1[name][:8]
+
+
+def _download_pinned(name, root):
+    """Fetch `<repo>/gluon/models/<name>-<short>.zip`, extract the
+    .params, verify the sha1 pin (reference model_store.py:106)."""
+    import urllib.error
+    import urllib.request
+
+    repo = os.environ.get("MXNET_GLUON_REPO", _DEFAULT_REPO)
+    if not repo.endswith("/"):
+        repo += "/"
+    fname = "%s-%s" % (name, _short_hash(name))
+    url = "%sgluon/models/%s.zip" % (repo, fname)
+    os.makedirs(root, exist_ok=True)
+    zpath = os.path.join(root, fname + ".zip")
+    try:
+        urllib.request.urlretrieve(url, zpath)
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(
+            "could not download pretrained %r from %s (%s). This "
+            "environment may have no network egress — place the "
+            "reference-format %s.params under %s instead."
+            % (name, url, e, fname, root))
+    with zipfile.ZipFile(zpath) as zf:
+        zf.extractall(root)
+    os.remove(zpath)
+    out = os.path.join(root, fname + ".params")
+    if not os.path.exists(out):
+        raise RuntimeError("archive for %r had no %s.params" % (name,
+                                                                fname))
+    return out
+
 
 def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
-    """Locate a pretrained parameter file on disk
-    (reference: model_store.py:68)."""
+    """Return the path of a sha1-pinned pretrained checkpoint,
+    downloading it if absent (reference: model_store.py:71)."""
     root = os.path.expanduser(root or os.path.join("~", ".mxnet",
                                                    "models"))
+    pinned = _MODEL_SHA1.get(name)
     if os.path.isdir(root):
+        # pinned cache file first, then any user-placed variant
+        if pinned:
+            cached = os.path.join(
+                root, "%s-%s.params" % (name, pinned[:8]))
+            if os.path.exists(cached):
+                if _sha1_of(cached) == pinned:
+                    return cached
+                os.remove(cached)  # corrupt/stale: re-fetch below
         exact = os.path.join(root, "%s.params" % name)
         if os.path.exists(exact):
             return exact
         for fname in sorted(os.listdir(root)):
             if fname.startswith(name + "-") and fname.endswith(".params"):
                 return os.path.join(root, fname)
-    raise RuntimeError(
-        "Pretrained model file for %r not found under %s. This "
-        "environment has no network egress; place the reference-format "
-        ".params file there manually." % (name, root))
+    if pinned is None:
+        raise RuntimeError(
+            "no checkpoint for %r found under %s and none is published "
+            "for that name; place a .params file there manually."
+            % (name, root))
+    path = _download_pinned(name, root)
+    if _sha1_of(path) != pinned:
+        raise RuntimeError(
+            "downloaded checkpoint for %r failed its sha1 pin "
+            "(%s != %s) — refusing to use it"
+            % (name, _sha1_of(path), pinned))
+    return path
 
 
 def purge(root=os.path.join("~", ".mxnet", "models")):

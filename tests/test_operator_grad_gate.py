@@ -606,3 +606,63 @@ def _run_case(name, spec):
 @pytest.mark.parametrize("name", sorted(GRAD_CASES), ids=sorted(GRAD_CASES))
 def test_numeric_gradient(name, ):
     _run_case(name, GRAD_CASES[name])
+
+
+# ---------------------------------------------------------------------------
+# second-order spot checks: jax.grad(jax.grad(...)) vs central differences
+# of the analytic first derivative, on representative smooth ops (the
+# breadth backing autograd.grad(create_graph=True) beyond the tape tests)
+# ---------------------------------------------------------------------------
+
+SECOND_ORDER_CASES = {
+    "tanh": ([U((3, 4), -1.5, 1.5)], {}),
+    "sigmoid": ([U((3, 4), -2, 2)], {}),
+    "exp": ([U((3, 4), -1, 1)], {}),
+    "log": ([P((3, 4), 0.5, 3)], {}),
+    "square": ([U((3, 4))], {}),
+    "softmax": ([U((3, 4))], {"axis": -1}),
+    "FullyConnected": ([U((2, 5)), U((3, 5)), U((3,))],
+                       {"num_hidden": 3}),
+    "Convolution": ([U((1, 4, 4, 2)), U((2, 3, 3, 2)), U((2,))],
+                    {"kernel": (3, 3), "num_filter": 2,
+                     "layout": "NHWC"}),
+    "LayerNorm": ([U((3, 4)), P((4,)), U((4,))], {}),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SECOND_ORDER_CASES),
+                         ids=sorted(SECOND_ORDER_CASES))
+def test_second_order_gradient(name):
+    arrays, params = SECOND_ORDER_CASES[name]
+    op = R.get(name)
+    full = R.apply_defaults(op, dict(params))
+    if op.takes_mode:
+        full["_mode"] = "predict"
+    xs = [jnp.asarray(a) for a in arrays]
+
+    def f(x0):
+        out = op.fn(x0, *xs[1:], **full)
+        out = out[0] if isinstance(out, tuple) else out
+        # nonlinear functional so the 2nd derivative is nontrivial
+        # even for linear ops (FC/conv)
+        return jnp.sum(jnp.tanh(out.astype(jnp.float32)))
+
+    g = jax.grad(f)
+    gg = np.asarray(jax.grad(lambda x: jnp.sum(g(x)))(xs[0]), "float64")
+    base = np.asarray(arrays[0], "float64")
+    eps = 1e-3
+    import zlib
+    rng = np.random.RandomState(zlib.crc32(name.encode()) & 0x7fffffff)
+    flat = base.reshape(-1)
+    for idx in rng.choice(flat.size, size=min(3, flat.size),
+                          replace=False):
+        xp = flat.copy(); xp[idx] += eps
+        xm = flat.copy(); xm[idx] -= eps
+        gp = float(np.sum(np.asarray(
+            g(jnp.asarray(xp.reshape(base.shape), "float32")))))
+        gm = float(np.sum(np.asarray(
+            g(jnp.asarray(xm.reshape(base.shape), "float32")))))
+        num = (gp - gm) / (2 * eps)
+        got = gg.reshape(-1)[idx]
+        assert np.isclose(got, num, rtol=0.05, atol=5e-2), (
+            "%s: d2[%d] %g vs numeric %g" % (name, idx, got, num))
