@@ -196,3 +196,8 @@ def test_mnist_module_fit():
     out = run_example("image_classification/train_mnist.py",
                       "--epochs", "6")
     assert "MNIST_EXAMPLE_OK" in out
+
+
+def test_dsd_training():
+    out = run_example("dsd/dsd_train.py", "--epochs-per-phase", "3")
+    assert "DSD_OK" in out

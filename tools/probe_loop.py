@@ -1,0 +1,65 @@
+"""Tunnel watcher: sequential fresh-interpreter device probes.
+
+Round-5 operational learning (PERF.md §8): the axon outage mode fails
+each probe cleanly after ~25 min server-side, so a ~30-min cadence
+loop is the right monitor — and probing from a subprocess that exits
+normally is safe (an in-process failed init wedges that process's jax
+forever; see the memory notes in kill_stale.py's docstring).
+
+Usage:
+    python tools/probe_loop.py [--log /tmp/tpu_probe_loop.log] &
+The loop exits after the first success, appending TUNNEL_UP — then run,
+in order, in ONE generously-timed process each (never under `timeout`):
+    python tools/mfu_probe.py
+    python tools/train_gates.py
+    python bench.py
+"""
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+PROBE = (
+    "import time,json\n"
+    "t0=time.time()\n"
+    "try:\n"
+    "    import jax\n"
+    "    devs=jax.devices()\n"
+    "    print(json.dumps({'ts':time.time(),'ok':True,"
+    "'t':round(time.time()-t0,1),'devs':[str(d) for d in devs]}),"
+    "flush=True)\n"
+    "except Exception as e:\n"
+    "    print(json.dumps({'ts':time.time(),'ok':False,"
+    "'t':round(time.time()-t0,1),'err':str(e)[:160]}),flush=True)\n"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default="/tmp/tpu_probe_loop.log")
+    ap.add_argument("--interval", type=int, default=300,
+                    help="sleep between probes (each probe itself may "
+                         "take ~25 min to fail)")
+    args = ap.parse_args()
+    while True:
+        r = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, text=True)
+        line = (r.stdout or "").strip() or json.dumps(
+            {"ts": time.time(), "ok": False, "err": "probe died: %s"
+             % (r.stderr or "")[-120:]})
+        with open(args.log, "a") as f:
+            f.write(line + "\n")
+        try:
+            if json.loads(line).get("ok"):
+                with open(args.log, "a") as f:
+                    f.write("TUNNEL_UP %d\n" % time.time())
+                print("TUNNEL_UP")
+                return 0
+        except ValueError:
+            pass
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
