@@ -201,3 +201,14 @@ def test_mnist_module_fit():
 def test_dsd_training():
     out = run_example("dsd/dsd_train.py", "--epochs-per-phase", "3")
     assert "DSD_OK" in out
+
+
+def test_bayes_by_backprop():
+    out = run_example("bayesian-methods/bayes_by_backprop.py",
+                      "--epochs", "15")
+    assert "BAYES_OK" in out
+
+
+def test_gradcam_visualization():
+    out = run_example("cnn_visualization/gradcam.py", "--epochs", "5")
+    assert "GRADCAM_OK" in out
