@@ -212,3 +212,13 @@ def test_bayes_by_backprop():
 def test_gradcam_visualization():
     out = run_example("cnn_visualization/gradcam.py", "--epochs", "5")
     assert "GRADCAM_OK" in out
+
+
+def test_memcost_remat():
+    out = run_example("memcost/memory_cost.py")
+    assert "MEMCOST_OK" in out
+
+
+def test_deep_embedded_clustering():
+    out = run_example("deep-embedded-clustering/dec.py")
+    assert "DEC_OK" in out
