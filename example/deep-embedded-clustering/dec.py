@@ -106,7 +106,6 @@ def main():
     # phase 3: DEC self-training — KL(p || q) through the encoder
     mu = nd.array(cents.astype("float32"))
     mu.attach_grad()
-    t_mu = None  # updated manually with the encoder's optimizer step
     for it in range(args.dec_iters):
         idx = rng.permutation(n)[:B]
         xb = nd.array(X[idx])
@@ -123,8 +122,7 @@ def main():
                                        - nd.log(q + 1e-9)), axis=1))
         loss.backward()
         t_enc.step(B)
-        mu -= 1e-2 * mu.grad
-        mu.grad[:] = 0
+        mu -= 1e-2 * mu.grad   # grad_req='write': fresh each backward
 
     codes = enc(nd.array(X)).asnumpy()
     a2 = ((codes[:, None] - mu.asnumpy()[None]) ** 2).sum(-1).argmin(1)

@@ -57,15 +57,21 @@ def main():
             h = ck(h, W)
         return jnp.mean((h - y) ** 2)
 
+    # one jit/lower/compile per variant, reused by every probe below
+    jits = {name: jax.jit(jax.grad(fn))
+            for name, fn in [("plain", loss_plain), ("remat", loss_remat)]}
+    lowered = {k: v.lower(Ws, x) for k, v in jits.items()}
+    compiled = {k: v.compile() for k, v in lowered.items()}
+
     # the structural trade, visible in the lowered program BEFORE the
     # backend optimizes: remat re-traces every block's forward inside
     # the backward (2x the tanh ops, +L recompute matmuls), which is
     # exactly what frees the activation buffers between fwd and bwd
-    def op_counts(fn):
-        txt = jax.jit(jax.grad(fn)).lower(Ws, x).as_text()
+    def op_counts(name):
+        txt = lowered[name].as_text()
         return txt.count("dot_general"), txt.count("tanh")
 
-    (d0, t0), (d1, t1) = op_counts(loss_plain), op_counts(loss_remat)
+    (d0, t0), (d1, t1) = op_counts("plain"), op_counts("remat")
     print("lowered-program ops: plain %d dots / %d tanh; "
           "remat %d dots / %d tanh" % (d0, t0, d1, t1))
     assert t1 >= 2 * t0 and d1 >= d0 + L - 1, \
@@ -76,15 +82,15 @@ def main():
     # track HBM-style activation liveness — the byte savings are a TPU
     # property; tools/mfu_probe.py measures the b256 remat rows on the
     # chip, PERF.md)
-    for name, fn in [("plain", loss_plain), ("remat", loss_remat)]:
-        m = jax.jit(jax.grad(fn)).lower(Ws, x).compile().memory_analysis()
+    for name in ("plain", "remat"):
+        m = compiled[name].memory_analysis()
         print("  %s: peak %.1f MiB (backend=%s)"
               % (name, m.peak_memory_in_bytes / 2**20,
                  jax.default_backend()))
 
     # identical numerics: remat recomputes, it does not approximate
-    g1 = jax.jit(jax.grad(loss_plain))(Ws, x)
-    g2 = jax.jit(jax.grad(loss_remat))(Ws, x)
+    g1 = jits["plain"](Ws, x)
+    g2 = jits["remat"](Ws, x)
     err = max(float(jnp.abs(a - b).max()) for a, b in zip(g1, g2))
     print("max grad difference plain-vs-remat: %.2e" % err)
     assert err < 1e-5, "remat changed numerics"
