@@ -98,6 +98,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import mxnet_tpu as mx
 
+    np.random.seed(1)   # NDArrayIter(shuffle=True) draws from the
+    #                       global numpy RNG — pin it for reproducibility
     train_iter, val_iter = get_data(args)
     mod = mx.mod.Module(lenet(), label_names=["softmax_label"])
     mod.fit(train_iter, eval_data=val_iter, num_epoch=args.epochs,

@@ -194,7 +194,7 @@ def test_word_lm():
 
 def test_mnist_module_fit():
     out = run_example("image_classification/train_mnist.py",
-                      "--epochs", "6")
+                      "--epochs", "8")
     assert "MNIST_EXAMPLE_OK" in out
 
 
