@@ -106,7 +106,8 @@ class ShardedTrainer:
                  data_names=("data",), label_names=("label",),
                  aux_mode="train", compute_dtype=None,
                  gradient_compression=None,
-                 shard_optimizer_state=False, remat=False):
+                 shard_optimizer_state=False, remat=False,
+                 input_specs=None):
         """compute_dtype: e.g. "bfloat16" for mixed precision — master
         params stay fp32; weights (ndim>=2) and data inputs are cast to
         the compute dtype inside the step, so matmuls/convs hit the MXU
@@ -165,6 +166,13 @@ class ShardedTrainer:
         self._label_names = tuple(label_names)
         self._param_rules = [(re.compile(p), spec)
                              for p, spec in (param_rules or [])]
+        # per-input PartitionSpec overrides (e.g. {"data": ("dp", "sp")}
+        # shards long sequences over the sp axis at ingest, so no device
+        # ever materializes the full sequence before the compute's own
+        # resharding). Unlisted inputs keep the batch-axis default.
+        self._input_specs = {
+            k: (v if isinstance(v, PartitionSpec) else PartitionSpec(*v))
+            for k, v in (input_specs or {}).items()}
         self._shard_opt = bool(shard_optimizer_state)
 
         # trace net + loss into one symbol graph
@@ -279,6 +287,14 @@ class ShardedTrainer:
         spec[ax] = self._dp_axis_name()
         return NamedSharding(self._mesh, PartitionSpec(*spec))
 
+    def _input_sharding(self, name, ndim=None):
+        """Sharding for a named input: explicit input_specs override,
+        else the batch-axis default."""
+        over = self._input_specs.get(name)
+        if over is not None:
+            return NamedSharding(self._mesh, over)
+        return self._batch_sharding(ndim)
+
     # -- compiled step --------------------------------------------------
     def _make_step_body(self):
         """The pure per-step function (params, aux, opt_state, inputs,
@@ -342,7 +358,7 @@ class ShardedTrainer:
             opt_sh = _match_param_shardings(self._opt_state, param_sh,
                                             rep)
         ndims = getattr(self, "_input_ndims", {})
-        in_sh = {n: self._batch_sharding(ndims.get(n))
+        in_sh = {n: self._input_sharding(n, ndims.get(n))
                  for n in self._data_names + self._label_names}
         return param_sh, aux_sh, opt_sh, in_sh, rep
 
@@ -407,7 +423,7 @@ class ShardedTrainer:
             arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             ndims[n] = arr.ndim
             inputs[n] = jax.device_put(arr,
-                                       self._batch_sharding(arr.ndim))
+                                       self._input_sharding(n, arr.ndim))
         if getattr(self, "_step_many_fn", None) is None:
             self._input_ndims = ndims
             self._build_step_many()
@@ -427,10 +443,11 @@ class ShardedTrainer:
         (device_put on an already-placed array is an alias, not a
         copy)."""
         staged = []
-        for x in parts:
+        names = self._data_names + self._label_names
+        for n, x in zip(names, parts):
             arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             staged.append(NDArray(jax.device_put(
-                arr, self._batch_sharding(arr.ndim))))
+                arr, self._input_sharding(n, arr.ndim))))
         return staged
 
     def prefetched(self, data_iter, depth=2):
@@ -571,7 +588,7 @@ class ShardedTrainer:
         opt_sh = _match_param_shardings(self._opt_state, param_sh, rep)
         res_sh = {n: NamedSharding(self._mesh, PartitionSpec(dp))
                   for n in self._gc_residuals}
-        in_sh = {n: self._batch_sharding(ndims.get(n))
+        in_sh = {n: self._input_sharding(n, ndims.get(n))
                  for n in self._data_names + self._label_names}
         self._step_fn = jax.jit(
             step,
@@ -590,7 +607,7 @@ class ShardedTrainer:
             arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             ndims[n] = arr.ndim
             inputs[n] = jax.device_put(arr,
-                                       self._batch_sharding(arr.ndim))
+                                       self._input_sharding(n, arr.ndim))
         if self._step_fn is None:
             self._input_ndims = ndims
             if self._grad_compression is not None:

@@ -409,3 +409,33 @@ def test_remat_matches_plain_step():
     assert np.allclose(plain, remat, rtol=1e-5), (plain, remat)
     sel = train("dots_with_no_batch_dims_saveable")
     assert np.allclose(plain, sel, rtol=1e-5), (plain, sel)
+
+
+def test_input_specs_override_matches_default():
+    """input_specs shards the sequence axis of the inputs over 'sp' at
+    ingest; numerics must equal the batch-default sharding."""
+    import jax
+    np.random.seed(0)
+    B, T, D = 4, 16, 8
+    X = np.random.randn(B, T, D).astype("float32")
+    Y = np.random.randn(B, T, 1).astype("float32")
+
+    net = nn.Dense(1, flatten=False)
+    net.initialize()
+    net(mx.nd.array(X[:1]))
+
+    def build(input_specs=None):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        return ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                              "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                              input_specs=input_specs)
+
+    a = build()
+    b = build(input_specs={"data": ("dp", "sp"),
+                           "label": ("dp", "sp")})
+    la = [float(a.step(X, Y).asscalar()) for _ in range(3)]
+    lb = [float(b.step(X, Y).asscalar()) for _ in range(3)]
+    assert np.allclose(la, lb, rtol=1e-6), (la, lb)
+    # the staged input really is sequence-sharded
+    sh = b._input_sharding("data", 3)
+    assert "sp" in str(sh.spec)
