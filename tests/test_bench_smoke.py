@@ -19,8 +19,10 @@ def test_bench_smoke_cpu():
                # asserts their presence, so skipping must be a failure
                MXTPU_BENCH_BUDGET_S="100000")
     env.pop("JAX_PLATFORMS", None)
+    # ladder mode (the driver path) runs the measurement in three
+    # fresh-interpreter rungs: allow for three compiles, not one
     r = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
-                       capture_output=True, text=True, timeout=1500,
+                       capture_output=True, text=True, timeout=4200,
                        env=env)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
