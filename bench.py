@@ -322,16 +322,28 @@ def _rungs():
     size overrides apply inside each rung (min with the rung's cap).
     """
     deadlines = [float(x) for x in os.environ.get(
-        "MXTPU_BENCH_DEADLINES", "900,1500,2400").split(",")
+        "MXTPU_BENCH_DEADLINES", "900,900,1500,2400").split(",")
         if x.strip()]
-    while len(deadlines) < 3:  # a single value bounds every rung
-        deadlines.append(deadlines[-1] if deadlines else 900.0)
-    return [
-        # (name, steps, unroll, score?, extras?, deadline_s)
-        ("secure", min(8, STEPS), 1, False, False, deadlines[0]),
-        ("mid", STEPS, min(2, UNROLL), True, False, deadlines[1]),
-        ("full", STEPS, UNROLL, True, True, deadlines[2]),
+    specs = [
+        # (name, steps, unroll, score?, extras?) — round-5 chip lesson:
+        # the rung that bundled the train upgrade WITH the score compile
+        # wedged and took the lease with it, so train-upgrade and score
+        # are now separate rungs (score reuses the secure-size train
+        # program, which the persistent compile cache makes nearly free)
+        ("secure", min(8, STEPS), 1, False, False),
+        ("score", min(8, STEPS), 1, True, False),
+        ("mid", STEPS, min(2, UNROLL), False, False),
+        ("full", STEPS, UNROLL, True, True),
     ]
+    while len(deadlines) < len(specs):  # a short list bounds the rest
+        deadlines.append(deadlines[-1] if deadlines else 900.0)
+    rungs = [s + (d,) for s, d in zip(specs, deadlines)]
+    if not _flag("MXTPU_BENCH_SCORE"):
+        # with scoring masked off, the score rung would be an exact
+        # duplicate of secure — don't spend a chip-window child on it
+        # (deadlines are zipped first so the others keep their slots)
+        rungs = [r for r in rungs if r[0] != "score"]
+    return rungs
 
 
 def _run_rung(name, steps, unr, score, extras, deadline):
@@ -359,7 +371,7 @@ def _run_rung(name, steps, unr, score, extras, deadline):
         out, _ = p.communicate(timeout=deadline)
     except subprocess.TimeoutExpired as e:
         timed_out, out = True, (e.stdout or "")
-        for sig, grace in ((signal.SIGINT, 90), (signal.SIGTERM, 30),
+        for sig, grace in ((signal.SIGINT, 120), (signal.SIGTERM, 30),
                            (signal.SIGKILL, 30)):
             p.send_signal(sig)
             try:
@@ -370,7 +382,10 @@ def _run_rung(name, steps, unr, score, extras, deadline):
                 continue
 
     def parse():
-        lines = [l for l in (out or "").splitlines()
+        text = out or ""
+        if isinstance(text, bytes):  # TimeoutExpired.stdout is bytes
+            text = text.decode("utf-8", "replace")  # even under text=True
+        lines = [l for l in text.splitlines()
                  if l.startswith("{")]
         if not lines:
             return None
@@ -390,7 +405,24 @@ def _run_rung(name, steps, unr, score, extras, deadline):
     return r, "ok"
 
 
+def _enable_compile_cache():
+    """Persistent XLA compile cache shared by every child interpreter
+    (and by later bench runs on this host). Through the dev tunnel a
+    large-program compile is both slow (~minutes) and the lease-wedge
+    trigger (round-5 chip log), so reusing executables across rungs and
+    across runs is the single best de-risking lever. Backends whose
+    PJRT client can't serialize executables just log a warning and
+    compile as before. MXTPU_XLA_CACHE=0 disables."""
+    default = "/tmp/mxtpu_xla_cache_%d" % os.getuid()  # per-user: a
+    # fixed shared /tmp path could collide with (or be poisoned by)
+    # another user's dir on a multi-user host
+    d = os.environ.get("MXTPU_XLA_CACHE", default)
+    if d and d != "0":
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+
+
 def main():
+    _enable_compile_cache()
     if os.environ.get("MXTPU_BENCH_CHILD"):
         return _measure_main()
     _apply_platform_override()

@@ -41,13 +41,27 @@ def main():
     ap.add_argument("--interval", type=int, default=300,
                     help="sleep between probes (each probe itself may "
                          "take ~25 min to fail)")
+    ap.add_argument("--probe-timeout", type=int, default=1800,
+                    help="watchdog per probe: the round-5 wedge mode "
+                         "HANGS jax.devices() instead of erroring "
+                         "after ~25 min, so an unfenced probe blocks "
+                         "the loop forever. A probe that never got a "
+                         "device grant is safe to reap (kill_stale's "
+                         "init-hung class).")
     args = ap.parse_args()
     while True:
-        r = subprocess.run([sys.executable, "-c", PROBE],
-                           capture_output=True, text=True)
-        line = (r.stdout or "").strip() or json.dumps(
-            {"ts": time.time(), "ok": False, "err": "probe died: %s"
-             % (r.stderr or "")[-120:]})
+        try:
+            r = subprocess.run([sys.executable, "-c", PROBE],
+                               capture_output=True, text=True,
+                               timeout=args.probe_timeout)
+            line = (r.stdout or "").strip() or json.dumps(
+                {"ts": time.time(), "ok": False, "err": "probe died: %s"
+                 % (r.stderr or "")[-120:]})
+        except subprocess.TimeoutExpired:
+            line = json.dumps(
+                {"ts": time.time(), "ok": False,
+                 "err": "probe hung > %ds (wedge hang mode); reaped"
+                        % args.probe_timeout})
         with open(args.log, "a") as f:
             f.write(line + "\n")
         try:
