@@ -324,6 +324,11 @@ def _rungs():
     deadlines = [float(x) for x in os.environ.get(
         "MXTPU_BENCH_DEADLINES", "900,900,1500,2400").split(",")
         if x.strip()]
+    if len(deadlines) == 3:
+        # pre-round-5 spelling (secure,mid,full): keep its semantics —
+        # the score rung borrows secure's fence rather than silently
+        # shifting mid/full to looser bounds
+        deadlines = [deadlines[0]] + deadlines
     specs = [
         # (name, steps, unroll, score?, extras?) — round-5 chip lesson:
         # the rung that bundled the train upgrade WITH the score compile
@@ -413,12 +418,23 @@ def _enable_compile_cache():
     across runs is the single best de-risking lever. Backends whose
     PJRT client can't serialize executables just log a warning and
     compile as before. MXTPU_XLA_CACHE=0 disables."""
-    default = "/tmp/mxtpu_xla_cache_%d" % os.getuid()  # per-user: a
-    # fixed shared /tmp path could collide with (or be poisoned by)
-    # another user's dir on a multi-user host
+    default = "/tmp/mxtpu_xla_cache_%d" % os.getuid()
     d = os.environ.get("MXTPU_XLA_CACHE", default)
-    if d and d != "0":
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    if not d or d == "0":
+        return
+    if d == default:
+        # the default lives in world-writable /tmp: refuse a directory
+        # we don't own with 0700 (someone else could pre-create it and
+        # plant serialized executables); an explicit MXTPU_XLA_CACHE
+        # path is the operator's own responsibility
+        try:
+            os.makedirs(d, mode=0o700, exist_ok=True)
+            st = os.stat(d)
+            if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+                return
+        except OSError:
+            return
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
 
 
 def main():

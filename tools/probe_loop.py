@@ -35,6 +35,32 @@ PROBE = (
 )
 
 
+def _fenced_probe(timeout_s):
+    """One probe child under a watchdog. On timeout, escalate
+    SIGINT -> SIGTERM -> SIGKILL with grace (bench._run_rung's ladder):
+    if the hang happens AFTER the relay granted the lease, a clean
+    KeyboardInterrupt unwind releases it, where a blunt SIGKILL would
+    wedge it (develop_and_hack.md rule 7). Returns (stdout, status)."""
+    import signal
+    p = subprocess.Popen([sys.executable, "-c", PROBE],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = p.communicate(timeout=timeout_s)
+        return out, "ok"
+    except subprocess.TimeoutExpired:
+        pass
+    for sig, grace in ((signal.SIGINT, 60), (signal.SIGTERM, 20),
+                       (signal.SIGKILL, 20)):
+        p.send_signal(sig)
+        try:
+            p.communicate(timeout=grace)
+            return None, signal.Signals(sig).name
+        except subprocess.TimeoutExpired:
+            continue
+    return None, "unreaped"
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default="/tmp/tpu_probe_loop.log")
@@ -50,18 +76,15 @@ def main():
                          "init-hung class).")
     args = ap.parse_args()
     while True:
-        try:
-            r = subprocess.run([sys.executable, "-c", PROBE],
-                               capture_output=True, text=True,
-                               timeout=args.probe_timeout)
-            line = (r.stdout or "").strip() or json.dumps(
-                {"ts": time.time(), "ok": False, "err": "probe died: %s"
-                 % (r.stderr or "")[-120:]})
-        except subprocess.TimeoutExpired:
+        out, status = _fenced_probe(args.probe_timeout)
+        if status == "ok":
+            line = (out or "").strip() or json.dumps(
+                {"ts": time.time(), "ok": False, "err": "probe died"})
+        else:
             line = json.dumps(
                 {"ts": time.time(), "ok": False,
-                 "err": "probe hung > %ds (wedge hang mode); reaped"
-                        % args.probe_timeout})
+                 "err": "probe hung > %ds (wedge hang mode); reaped "
+                        "via %s" % (args.probe_timeout, status)})
         with open(args.log, "a") as f:
             f.write(line + "\n")
         try:
