@@ -351,12 +351,35 @@ def _rungs():
     return rungs
 
 
+def fence_child(p, graces=None):
+    """Reap a deadline-struck child with SIGINT -> SIGTERM -> SIGKILL
+    escalation: the clean KeyboardInterrupt unwind closes the PJRT
+    client and releases the device lease, where a blunt kill wedges it
+    (PERF.md §9). Shared by the bench rungs and tools/probe_loop.py.
+    Returns (stdout_so_far, signal_name|'unreaped') — output the child
+    printed before wedging is real and must be kept."""
+    import signal
+    import subprocess
+    graces = graces or ((signal.SIGINT, 120), (signal.SIGTERM, 30),
+                        (signal.SIGKILL, 30))
+    out = None
+    for sig, grace in graces:
+        p.send_signal(sig)
+        try:
+            got, _ = p.communicate(timeout=grace)
+            return got if got is not None else out, \
+                signal.Signals(sig).name
+        except subprocess.TimeoutExpired as e:
+            if e.stdout is not None:
+                out = e.stdout
+            continue
+    return out, "unreaped"
+
+
 def _run_rung(name, steps, unr, score, extras, deadline):
     """One ladder rung in a fresh interpreter. Returns (result|None,
-    status). On deadline: SIGINT first (a clean KeyboardInterrupt
-    unwind closes the PJRT client and releases the device lease),
-    escalating only if the child is stuck in a C call."""
-    import signal
+    status). On deadline the child is reaped via fence_child (SIGINT
+    first; escalating only if it is stuck in a C call)."""
     import subprocess
     import sys
     env = dict(os.environ)
@@ -375,16 +398,9 @@ def _run_rung(name, steps, unr, score, extras, deadline):
     try:
         out, _ = p.communicate(timeout=deadline)
     except subprocess.TimeoutExpired as e:
-        timed_out, out = True, (e.stdout or "")
-        for sig, grace in ((signal.SIGINT, 120), (signal.SIGTERM, 30),
-                           (signal.SIGKILL, 30)):
-            p.send_signal(sig)
-            try:
-                out, _ = p.communicate(timeout=grace)
-                break
-            except subprocess.TimeoutExpired as e2:
-                out = e2.stdout or out
-                continue
+        timed_out = True
+        fenced, _sig = fence_child(p)
+        out = fenced if fenced is not None else (e.stdout or "")
 
     def parse():
         text = out or ""
@@ -429,7 +445,9 @@ def _enable_compile_cache():
         # path is the operator's own responsibility
         try:
             os.makedirs(d, mode=0o700, exist_ok=True)
-            st = os.stat(d)
+            if os.path.islink(d):  # lstat, not stat: a foreign symlink
+                return             # to a dir we own passes the checks
+            st = os.lstat(d)
             if st.st_uid != os.getuid() or (st.st_mode & 0o022):
                 return
         except OSError:

@@ -1,0 +1,92 @@
+"""Unit tests for bench.py's ladder construction and compile-cache
+guard — the pure-Python pieces the CPU smoke exercises only end-to-end.
+These run in milliseconds (no jax import)."""
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    # a fresh module per test so env-derived module constants reset
+    monkeypatch.setenv("MXTPU_XLA_CACHE", str(tmp_path / "cache"))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_ladder_order_and_shape(bench, monkeypatch):
+    monkeypatch.delenv("MXTPU_BENCH_DEADLINES", raising=False)
+    monkeypatch.delenv("MXTPU_BENCH_SCORE", raising=False)
+    rungs = bench._rungs()
+    assert [r[0] for r in rungs] == ["secure", "score", "mid", "full"]
+    # secure and score measure the identical small train config; the
+    # score rung exists only to isolate the inference compile
+    assert rungs[0][1:3] == rungs[1][1:3]
+    # escalation is monotone in work: steps then unroll
+    assert rungs[2][1] >= rungs[0][1] and rungs[3][2] >= rungs[2][2]
+
+
+def test_legacy_three_value_deadlines_keep_meaning(bench, monkeypatch):
+    monkeypatch.setenv("MXTPU_BENCH_DEADLINES", "111,222,333")
+    by_name = {r[0]: r[5] for r in bench._rungs()}
+    # pre-round-5 spelling was (secure, mid, full): mid/full must NOT
+    # silently inherit looser fences; score borrows secure's
+    assert by_name == {"secure": 111.0, "score": 111.0,
+                       "mid": 222.0, "full": 333.0}
+
+
+def test_single_deadline_bounds_every_rung(bench, monkeypatch):
+    monkeypatch.setenv("MXTPU_BENCH_DEADLINES", "77")
+    assert [r[5] for r in bench._rungs()] == [77.0] * 4
+
+
+def test_score_rung_dropped_when_scoring_masked(bench, monkeypatch):
+    monkeypatch.setenv("MXTPU_BENCH_SCORE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DEADLINES", "1,2,3,4")
+    rungs = bench._rungs()
+    assert [r[0] for r in rungs] == ["secure", "mid", "full"]
+    # deadlines are zipped before the drop so the others keep slots
+    assert [r[5] for r in rungs] == [1.0, 3.0, 4.0]
+
+
+def _guard_cache_env(monkeypatch):
+    """_enable_compile_cache writes JAX_COMPILATION_CACHE_DIR straight
+    into os.environ; register the var with monkeypatch first so the
+    mutation is rolled back after the test instead of leaking into
+    later jax-importing tests."""
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "sentinel")
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+
+
+def test_compile_cache_env_respects_explicit_dir(bench, monkeypatch,
+                                                 tmp_path):
+    target = tmp_path / "explicit"
+    monkeypatch.setenv("MXTPU_XLA_CACHE", str(target))
+    _guard_cache_env(monkeypatch)
+    bench._enable_compile_cache()
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == str(target)
+
+
+def test_compile_cache_disabled_by_zero(bench, monkeypatch):
+    monkeypatch.setenv("MXTPU_XLA_CACHE", "0")
+    _guard_cache_env(monkeypatch)
+    bench._enable_compile_cache()
+    assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+
+
+def test_compile_cache_default_dir_created_private(bench, monkeypatch):
+    # exercise the ownership guard on the real uid-derived default
+    monkeypatch.delenv("MXTPU_XLA_CACHE", raising=False)
+    _guard_cache_env(monkeypatch)
+    bench._enable_compile_cache()
+    d = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if d is not None:  # guard may refuse a pre-existing foreign dir
+        assert not os.path.islink(d)
+        st = os.lstat(d)
+        assert st.st_uid == os.getuid()
+        assert not (st.st_mode & 0o022)

@@ -16,9 +16,15 @@ in order, in ONE generously-timed process each (never under `timeout`):
 """
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import fence_child  # noqa: E402 — shared reaping ladder
 
 PROBE = (
     "import time,json\n"
@@ -36,29 +42,25 @@ PROBE = (
 
 
 def _fenced_probe(timeout_s):
-    """One probe child under a watchdog. On timeout, escalate
-    SIGINT -> SIGTERM -> SIGKILL with grace (bench._run_rung's ladder):
-    if the hang happens AFTER the relay granted the lease, a clean
-    KeyboardInterrupt unwind releases it, where a blunt SIGKILL would
-    wedge it (develop_and_hack.md rule 7). Returns (stdout, status)."""
+    """One probe child under a watchdog. On timeout, reap with
+    bench.fence_child (SIGINT-first escalation): if the hang happens
+    AFTER the relay granted the lease, a clean KeyboardInterrupt
+    unwind releases it, where a blunt SIGKILL would wedge it
+    (develop_and_hack.md rule 7). Returns (stdout, stderr_tail,
+    status) — stdout the child printed before wedging is kept."""
     import signal
     p = subprocess.Popen([sys.executable, "-c", PROBE],
                          stdout=subprocess.PIPE,
-                         stderr=subprocess.DEVNULL, text=True)
+                         stderr=subprocess.PIPE, text=True)
     try:
-        out, _ = p.communicate(timeout=timeout_s)
-        return out, "ok"
+        out, err = p.communicate(timeout=timeout_s)
+        return out, (err or "")[-160:], "ok"
     except subprocess.TimeoutExpired:
         pass
-    for sig, grace in ((signal.SIGINT, 60), (signal.SIGTERM, 20),
-                       (signal.SIGKILL, 20)):
-        p.send_signal(sig)
-        try:
-            p.communicate(timeout=grace)
-            return None, signal.Signals(sig).name
-        except subprocess.TimeoutExpired:
-            continue
-    return None, "unreaped"
+    out, status = fence_child(p, graces=((signal.SIGINT, 60),
+                                         (signal.SIGTERM, 20),
+                                         (signal.SIGKILL, 20)))
+    return out, "", status
 
 
 def main():
@@ -76,15 +78,17 @@ def main():
                          "init-hung class).")
     args = ap.parse_args()
     while True:
-        out, status = _fenced_probe(args.probe_timeout)
-        if status == "ok":
-            line = (out or "").strip() or json.dumps(
-                {"ts": time.time(), "ok": False, "err": "probe died"})
-        else:
+        out, err_tail, status = _fenced_probe(args.probe_timeout)
+        # stdout the child completed before any wedge is the probe's
+        # real result — honor it whatever the reap status was
+        line = (out or "").strip()
+        if not line:
+            reason = ("probe died: %s" % err_tail if status == "ok"
+                      else "probe hung > %ds (wedge hang mode); "
+                           "reaped via %s" % (args.probe_timeout,
+                                              status))
             line = json.dumps(
-                {"ts": time.time(), "ok": False,
-                 "err": "probe hung > %ds (wedge hang mode); reaped "
-                        "via %s" % (args.probe_timeout, status)})
+                {"ts": time.time(), "ok": False, "err": reason})
         with open(args.log, "a") as f:
             f.write(line + "\n")
         try:
