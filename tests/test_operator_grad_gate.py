@@ -283,6 +283,19 @@ case("_contrib_ROIAlign",
      [P((1, 2, 6, 6)), np.array([[0, 0.5, 0.5, 3.5, 3.5]], "float32")],
      params={"pooled_size": (2, 2), "spatial_scale": 1.0}, wrt=(0,),
      atol=5e-2)
+case("_contrib_PSROIPooling",
+     [U((1, 8, 6, 6)), np.array([[0, 0, 0, 3, 3], [0, 1, 1, 4, 4]],
+                                "float32")],
+     params={"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+             "group_size": 2}, wrt=(0,), atol=5e-2)
+# trans values are kept small (|dx| <= 0.1 px) so no bilinear sample
+# crosses an integer grid line within the finite-difference eps
+case("_contrib_DeformablePSROIPooling",
+     [U((1, 8, 8, 8)), np.array([[0, 1, 1, 5, 5]], "float32"),
+      U((1, 2, 2, 2), -0.2, 0.2)],
+     params={"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+             "group_size": 2, "trans_std": 0.1, "no_trans": False},
+     wrt=(0, 2), atol=5e-2)
 case("_contrib_count_sketch", [U((2, 8)), np.array([0, 1, 0, 1, 1, 0, 1, 0],
                                                    "float32"),
                                np.array([1, 3, 0, 2, 4, 1, 0, 3], "float32")],
@@ -399,12 +412,14 @@ EXEMPT_BRIDGE = {
 
 # detection/proposal heads: outputs are box coordinates + scores whose
 # reference implementations are likewise non-differentiable C++ kernels
-# (no FGradient registered: multibox_*.cc, proposal.cc, bounding_box.cc)
+# (no FGradient registered: multibox_*.cc, proposal.cc, bounding_box.cc).
+# PSROIPooling / DeformablePSROIPooling do NOT belong here — the
+# reference trains through both (psroi_pooling.cc PSROIPoolBackwardAcc,
+# deformable_psroi_pooling.cc) — so they carry GRAD_CASES above.
 EXEMPT_DETECTION = {
     "_contrib_MultiBoxPrior", "_contrib_MultiBoxTarget",
     "_contrib_MultiBoxDetection", "_contrib_box_nms", "_contrib_box_iou",
     "_contrib_Proposal", "_contrib_MultiProposal",
-    "_contrib_PSROIPooling", "_contrib_DeformablePSROIPooling",
 }
 
 EXEMPT = (EXEMPT_NONFLOAT_OUTPUT | EXEMPT_PIECEWISE_CONSTANT
