@@ -468,6 +468,10 @@ class NDArray:
             self._data = jnp.broadcast_to(
                 jnp.asarray(value, self.dtype), self.shape)
         else:
+            # the value adopts THIS array's dtype (reference setitem
+            # semantics: a[0] = 9.0 into int32 stores 9) — also keeps
+            # jax's scatter from warning on unsafe float->int casts
+            value = jnp.asarray(value).astype(self.dtype)
             self._data = self._data.at[self._conv_index(key)].set(value)
 
     def __repr__(self):
