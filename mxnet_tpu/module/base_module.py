@@ -47,7 +47,6 @@ class BaseModule:
     def __init__(self, logger=logging):
         self.logger = logger
         self._symbol = None
-        self._total_exec_bytes = 0
         # lifecycle flags, flipped by bind/init_params/init_optimizer
         self.binded = False
         self.params_initialized = False
