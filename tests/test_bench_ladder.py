@@ -1,8 +1,13 @@
-"""Unit tests for bench.py's ladder construction and compile-cache
-guard — the pure-Python pieces the CPU smoke exercises only end-to-end.
-These run in milliseconds (no jax import)."""
+"""Unit tests for bench.py's ladder construction, compile-cache guard,
+and child-reaping fence — the pure-Python pieces the CPU smoke
+exercises only end-to-end. No jax import; the two fence tests spawn
+short-lived -S subprocesses and sync on a readiness line, so the whole
+file stays in low single-digit seconds."""
 import importlib.util
 import os
+import signal
+import subprocess
+import sys
 
 import pytest
 
@@ -52,6 +57,56 @@ def test_score_rung_dropped_when_scoring_masked(bench, monkeypatch):
     assert [r[0] for r in rungs] == ["secure", "mid", "full"]
     # deadlines are zipped before the drop so the others keep slots
     assert [r[5] for r in rungs] == [1.0, 3.0, 4.0]
+
+
+def _spawn_wedged(setup, payload):
+    """Start a -S python child that runs `setup` (e.g. signal handler
+    installs), prints `payload` to stdout, signals readiness on STDERR,
+    then sleeps forever. Readiness rides stderr so the parent's
+    buffered readline can't swallow the stdout payload fence_child's
+    communicate must see; blocking on it replaces any fixed sleep."""
+    emit = ("\nprint(%r, flush=True)\n"
+            "print('ready', file=sys.stderr, flush=True)\n"
+            "time.sleep(600)\n") % (payload,)
+    code = "import sys, time\n" + setup + emit  # setup never %-parsed
+    p = subprocess.Popen([sys.executable, "-S", "-c", code],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+    assert p.stderr.readline().strip() == "ready"
+    return p
+
+
+def test_fence_child_keeps_pre_wedge_stdout(bench):
+    # child emits its result, then wedges ignoring SIGINT/SIGTERM —
+    # the fence must escalate to SIGKILL AND return what was printed
+    p = _spawn_wedged(
+        "import signal\n"
+        "signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)",
+        '{"value": 42}')
+    try:
+        out, status = bench.fence_child(
+            p, graces=((signal.SIGINT, 1), (signal.SIGTERM, 1),
+                       (signal.SIGKILL, 5)))
+        assert status == "SIGKILL"
+        assert out is not None and '"value": 42' in out
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_fence_child_clean_sigint_unwind(bench):
+    # a child that honors SIGINT exits within the first grace window
+    p = _spawn_wedged("", "partial")
+    try:
+        out, status = bench.fence_child(
+            p, graces=((signal.SIGINT, 10), (signal.SIGTERM, 5),
+                       (signal.SIGKILL, 5)))
+        assert status == "SIGINT"
+        assert out is not None and "partial" in out
+    finally:
+        p.kill()
+        p.wait()
 
 
 def _guard_cache_env(monkeypatch):
