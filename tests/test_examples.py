@@ -188,7 +188,10 @@ def test_wgan_gradient_penalty():
 
 
 def test_word_lm():
-    out = run_example("rnn/word_lm.py", "--epochs", "2")
+    # 150-220 s/epoch on the 1-core CI box depending on load: the
+    # default 420 s budget sits on the 2-epoch line and flakes when
+    # anything else shares the core
+    out = run_example("rnn/word_lm.py", "--epochs", "2", timeout=540)
     assert "WORD_LM_OK" in out
 
 
