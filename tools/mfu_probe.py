@@ -28,6 +28,11 @@ import numpy as np
 RESULTS = {}
 
 
+def _out_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "PROBE_MFU.json")
+
+
 def _record(name, fn):
     t0 = time.time()
     try:
@@ -35,6 +40,19 @@ def _record(name, fn):
     except Exception as e:  # noqa: BLE001 — probe isolation
         RESULTS[name] = {"error": str(e)[:300]}
     RESULTS[name + "_wall_s"] = round(time.time() - t0, 1)
+    _flush()
+
+
+def _flush():
+    """Snapshot RESULTS after every probe: a later probe wedging in the
+    compile RPC (round-5 tunnel mode) hangs the process, but completed
+    results survive on disk. Atomic via os.replace so a kill mid-write
+    can't truncate what was already saved."""
+    out = _out_path()
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+    os.replace(tmp, out)
 
 
 def _resnet():
@@ -136,6 +154,8 @@ def bn_fusion_probe():
 
 
 def main():
+    from bench import _enable_compile_cache
+    _enable_compile_cache()   # share executables with bench runs
     from mxnet_tpu.base import probe_devices
     devs, err = probe_devices(timeout_s=240)
     if devs is None:
@@ -145,23 +165,24 @@ def main():
     jax.config.update("jax_default_matmul_precision", "bfloat16")
     RESULTS["devices"] = [str(d) for d in devs]
 
+    # smallest programs FIRST (bench-ladder lesson, PERF.md §9): the
+    # batch-ladder probes each compile a full 50-step train program —
+    # the riskiest phase through the tunnel — so the cheap kernel
+    # probes must already be on disk if one of those wedges
+    RESULTS["zero1_note"] = (
+        "shard_optimizer_state (ZeRO-1) shards over the dp mesh axis; "
+        "with ONE real chip dp=1 so there is nothing to shard — "
+        "a single-chip b256 memory fix must come from remat instead")
+    _flush()   # devices + the reasoned negative survive even a
+    _record("update_roofline", update_roofline)  # first-probe wedge
+    _record("bn_fusion", bn_fusion_probe)
     _record("b128_headline", batch_probe(128))
     _record("b192", batch_probe(192))
     _record("b256", batch_probe(256))
     _record("b256_remat_full", batch_probe(256, remat=True))
     _record("b256_remat_dots",
             batch_probe(256, remat="dots_with_no_batch_dims_saveable"))
-    RESULTS["zero1_note"] = (
-        "shard_optimizer_state (ZeRO-1) shards over the dp mesh axis; "
-        "with ONE real chip dp=1 so there is nothing to shard — "
-        "a single-chip b256 memory fix must come from remat instead")
-    _record("update_roofline", update_roofline)
-    _record("bn_fusion", bn_fusion_probe)
 
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                       "PROBE_MFU.json")
-    with open(out, "w") as f:
-        json.dump(RESULTS, f, indent=1)
     print(json.dumps(RESULTS))
     return 0
 
