@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 
 import jax
+import numpy as _np
 
 from ..base import MXNetError
 
@@ -79,16 +80,23 @@ class TrainerCheckpoint:
             lambda x: x.sharding if hasattr(x, "sharding") else None,
             target)
         try:
+            if self._known_structure_drift(step, target):
+                # don't attempt the strict restore when saved metadata
+                # already shows a recoverable drift (e.g. a residual
+                # bank saved under another world size): the doomed
+                # attempt floods the log with orbax/asyncio tracebacks
+                raise ValueError(
+                    "saved state structure differs from the trainer's "
+                    "(pre-detected from metadata; trying lenient "
+                    "restore)")
             restored = self._mngr.restore(
                 int(step),
                 args=self._ocp.args.StandardRestore(target))
         except Exception as err:
-            # Recoverable ONLY for structure drift on the optional
-            # gc_residuals key (old checkpoints lack it; compressed-
-            # trainer checkpoints carry it into a plain trainer). Any
-            # other mismatch — wrong shapes, different keys, corrupt
-            # data — re-raises the original validation error.
-            import numpy as _np
+            # Recoverable ONLY for structure drift the migrations below
+            # understand (gc_residual banks resized/absent, retired
+            # zero-momentum dicts). Anything else raises an error
+            # naming the offending key and shapes.
             raw = self._mngr.restore(int(step))
             if (set(raw) ^ set(target)) - {"gc_residuals"}:
                 raise
@@ -105,11 +113,22 @@ class TrainerCheckpoint:
                     continue
                 if (jax.tree.structure(raw[k])
                         != jax.tree.structure(tgt)):
-                    raise err
+                    raise MXNetError(
+                        "checkpoint step %s: %r tree structure on disk "
+                        "does not match the trainer's" % (step, k)
+                    ) from err
+                if k == "gc_residuals":
+                    restored[k] = self._reshard_residuals(raw[k], tgt,
+                                                          err)
+                    continue
                 for a, b in zip(jax.tree.leaves(raw[k]),
                                 jax.tree.leaves(tgt)):
                     if _np.shape(a) != _np.shape(b):
-                        raise err
+                        raise MXNetError(
+                            "checkpoint step %s: a %r leaf has shape "
+                            "%s on disk but the trainer expects %s"
+                            % (step, k, _np.shape(a), _np.shape(b))
+                        ) from err
                 restored[k] = raw[k]
         restored = jax.tree.map(
             lambda v, s: jax.device_put(v, s) if s is not None else v,
@@ -121,6 +140,54 @@ class TrainerCheckpoint:
             trainer._gc_residuals = dict(restored["gc_residuals"])
         trainer._step_count = int(restored["step"])
         return trainer._step_count
+
+    def _known_structure_drift(self, step, target):
+        """True when the checkpoint's saved metadata (shapes read
+        without touching array data) differs from the target tree in a
+        way the lenient path handles — so restore() can skip the
+        strict attempt that would noisily fail first."""
+        try:
+            meta = self._mngr.item_metadata(int(step))
+            saved_shapes = {k: [tuple(m.shape) for m in
+                                jax.tree.leaves(v)]
+                            for k, v in dict(meta).items()
+                            if v is not None}
+        except Exception:   # metadata unreadable: let restore decide
+            return False
+        tgt_shapes = {k: [tuple(_np.shape(x)) for x in
+                          jax.tree.leaves(v)]
+                      for k, v in target.items()}
+        return saved_shapes != tgt_shapes
+
+    @staticmethod
+    def _reshard_residuals(saved, target, err):
+        """Adapt error-feedback residuals across an elastic world-size
+        change. A residual bank has shape (n_dp, *param.shape), one
+        slice per data-parallel stream; correctness of error feedback
+        only requires the GLOBAL untransmitted error (the sum over
+        streams) to be preserved — per-stream attribution is just load
+        balancing. So on resize we spread each param's total evenly
+        over the new streams. Shapes must agree apart from that
+        leading axis; anything else is a real mismatch."""
+        out = {}
+        for name, tgt in target.items():
+            old = _np.asarray(saved[name])
+            new_shape = _np.shape(tgt)
+            if old.shape == new_shape:
+                out[name] = saved[name]
+                continue
+            if old.shape[1:] != tuple(new_shape[1:]):
+                raise MXNetError(
+                    "checkpoint residual bank %r has per-stream shape "
+                    "%s on disk but the trainer expects %s — only the "
+                    "leading (world size) axis may differ"
+                    % (name, old.shape[1:], tuple(new_shape[1:]))
+                ) from err
+            n_new = new_shape[0]
+            total = old.sum(axis=0, dtype=old.dtype)
+            out[name] = _np.broadcast_to(
+                total / n_new, new_shape).copy()
+        return out
 
     def restore_latest(self, trainer):
         """Restore the newest checkpoint; returns its step or None."""
