@@ -79,57 +79,30 @@ class TrainerCheckpoint:
         shardings = jax.tree.map(
             lambda x: x.sharding if hasattr(x, "sharding") else None,
             target)
-        try:
-            if self._known_structure_drift(step, target):
-                # don't attempt the strict restore when saved metadata
-                # already shows a recoverable drift (e.g. a residual
-                # bank saved under another world size): the doomed
-                # attempt floods the log with orbax/asyncio tracebacks
-                raise ValueError(
-                    "saved state structure differs from the trainer's "
-                    "(pre-detected from metadata; trying lenient "
-                    "restore)")
-            restored = self._mngr.restore(
-                int(step),
-                args=self._ocp.args.StandardRestore(target))
-        except Exception as err:
-            # Recoverable ONLY for structure drift the migrations below
-            # understand (gc_residual banks resized/absent, retired
-            # zero-momentum dicts). Anything else raises an error
-            # naming the offending key and shapes.
-            raw = self._mngr.restore(int(step))
-            if (set(raw) ^ set(target)) - {"gc_residuals"}:
-                raise
-            restored = {}
-            for k, tgt in target.items():
-                if k not in raw:
-                    restored[k] = tgt  # absent on disk: keep current
-                    continue
-                if k == "opt_state" and tgt == {} and \
-                        isinstance(raw[k], dict):
-                    # migration: plain-SGD trainers no longer carry the
-                    # zero-momentum dict older checkpoints saved
-                    restored[k] = {}
-                    continue
-                if (jax.tree.structure(raw[k])
-                        != jax.tree.structure(tgt)):
-                    raise MXNetError(
-                        "checkpoint step %s: %r tree structure on disk "
-                        "does not match the trainer's" % (step, k)
-                    ) from err
-                if k == "gc_residuals":
-                    restored[k] = self._reshard_residuals(raw[k], tgt,
-                                                          err)
-                    continue
-                for a, b in zip(jax.tree.leaves(raw[k]),
-                                jax.tree.leaves(tgt)):
-                    if _np.shape(a) != _np.shape(b):
-                        raise MXNetError(
-                            "checkpoint step %s: a %r leaf has shape "
-                            "%s on disk but the trainer expects %s"
-                            % (step, k, _np.shape(a), _np.shape(b))
-                        ) from err
-                restored[k] = raw[k]
+        drift = self._metadata_drift(step, target)
+        if drift:
+            # metadata (shapes read WITHOUT touching array data)
+            # already shows structural drift: don't attempt the strict
+            # restore (its doomed failure floods the log with
+            # orbax/asyncio tracebacks). Drift outside the migratable
+            # keys is fatal right here — before any data load.
+            fatal = drift - {"gc_residuals", "opt_state"}
+            if fatal:
+                raise MXNetError(
+                    "checkpoint step %s cannot restore into this "
+                    "trainer: saved shapes for %s do not match "
+                    "(metadata check)" % (step,
+                                          ", ".join(sorted(fatal))))
+            restored = self._lenient_restore(step, target, None)
+        else:
+            try:
+                restored = self._mngr.restore(
+                    int(step),
+                    args=self._ocp.args.StandardRestore(target))
+            except Exception as err:  # metadata agreed but the strict
+                # restore still objected (or metadata was unreadable,
+                # drift=None): fall back to the validated lenient path
+                restored = self._lenient_restore(step, target, err)
         restored = jax.tree.map(
             lambda v, s: jax.device_put(v, s) if s is not None else v,
             restored, shardings)
@@ -141,23 +114,65 @@ class TrainerCheckpoint:
         trainer._step_count = int(restored["step"])
         return trainer._step_count
 
-    def _known_structure_drift(self, step, target):
-        """True when the checkpoint's saved metadata (shapes read
-        without touching array data) differs from the target tree in a
-        way the lenient path handles — so restore() can skip the
-        strict attempt that would noisily fail first."""
+    def _metadata_drift(self, step, target):
+        """Compare the checkpoint's saved metadata (shapes read without
+        touching array data) against the target tree, per top-level
+        key. Returns the set of keys whose leaf shapes differ, or None
+        when metadata is unavailable (caller then lets the strict
+        restore decide)."""
         try:
             meta = self._mngr.item_metadata(int(step))
-            saved_shapes = {k: [tuple(m.shape) for m in
-                                jax.tree.leaves(v)]
-                            for k, v in dict(meta).items()
-                            if v is not None}
-        except Exception:   # metadata unreadable: let restore decide
-            return False
-        tgt_shapes = {k: [tuple(_np.shape(x)) for x in
-                          jax.tree.leaves(v)]
-                      for k, v in target.items()}
-        return saved_shapes != tgt_shapes
+            saved = {k: [tuple(m.shape) for m in jax.tree.leaves(v)]
+                     for k, v in dict(meta).items() if v is not None}
+        except Exception:
+            return None
+        tgt = {k: [tuple(_np.shape(x)) for x in jax.tree.leaves(v)]
+               for k, v in target.items()}
+        return {k for k in set(saved) | set(tgt)
+                if saved.get(k) != tgt.get(k)}
+
+    def _lenient_restore(self, step, target, cause):
+        """Raw restore + per-key validation and migrations: residual
+        banks resized across world sizes, residuals absent/extra, and
+        retired zero-momentum opt-state dicts. Anything else raises an
+        error naming the offending key and shapes. `cause` chains the
+        strict restore's failure when one was attempted."""
+        raw = self._mngr.restore(int(step))
+        if (set(raw) ^ set(target)) - {"gc_residuals"}:
+            raise MXNetError(
+                "checkpoint step %s holds state keys %s but the "
+                "trainer expects %s" % (step, sorted(raw),
+                                        sorted(target))) from cause
+        restored = {}
+        for k, tgt in target.items():
+            if k not in raw:
+                restored[k] = tgt  # absent on disk: keep current
+                continue
+            if k == "opt_state" and tgt == {} and \
+                    isinstance(raw[k], dict):
+                # migration: plain-SGD trainers no longer carry the
+                # zero-momentum dict older checkpoints saved
+                restored[k] = {}
+                continue
+            if jax.tree.structure(raw[k]) != jax.tree.structure(tgt):
+                raise MXNetError(
+                    "checkpoint step %s: %r tree structure on disk "
+                    "does not match the trainer's" % (step, k)
+                ) from cause
+            if k == "gc_residuals":
+                restored[k] = self._reshard_residuals(raw[k], tgt,
+                                                      cause)
+                continue
+            for a, b in zip(jax.tree.leaves(raw[k]),
+                            jax.tree.leaves(tgt)):
+                if _np.shape(a) != _np.shape(b):
+                    raise MXNetError(
+                        "checkpoint step %s: a %r leaf has shape %s "
+                        "on disk but the trainer expects %s"
+                        % (step, k, _np.shape(a), _np.shape(b))
+                    ) from cause
+            restored[k] = raw[k]
+        return restored
 
     @staticmethod
     def _reshard_residuals(saved, target, err):

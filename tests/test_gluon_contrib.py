@@ -189,3 +189,54 @@ def test_ring_attention_block_trains_under_sharded_trainer_sp_mesh():
     for _ in range(60):
         loss = st.step(X, Y)
     assert float(loss.asscalar()) < first * 0.5
+
+
+def test_moe_block_checkpoints_across_mesh_layouts(tmp_path):
+    """An expert-parallel trainer's state must checkpoint and restore
+    onto a DIFFERENT dp x ep factorization (orbax reshards leaves onto
+    the new mesh), and keep training — scaling experts up or down is
+    the ep analog of elastic dp resume."""
+    from mxnet_tpu.gluon.contrib.nn import MoEFFN
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+
+    class MoENet(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.moe = MoEFFN(embed_dim=8, hidden_size=16,
+                                  num_experts=4)
+                self.head = nn.Dense(1)
+
+        def hybrid_forward(self, F, x):
+            h, aux = self.moe(x)
+            return self.head(h), aux
+
+    np.random.seed(1)
+    X = np.random.randn(32, 8).astype("float32")
+    Y = (X[:, :1] * 2).astype("float32")
+    net = MoENet()
+    net.initialize()
+    net(mx.nd.array(X[:4]))
+
+    def loss_fn(out, label):
+        pred, aux = out
+        return gluon.loss.L2Loss()(pred, label) + 0.01 * aux
+
+    def trainer(mesh):
+        return ShardedTrainer(net, loss_fn, "adam",
+                              {"learning_rate": 0.02}, mesh=mesh)
+
+    a = trainer(make_mesh({"dp": 2, "ep": 4}))
+    for _ in range(3):
+        a.step(X, Y)
+    with TrainerCheckpoint(str(tmp_path / "ck")) as ck:
+        ck.save(3, a, wait=True)
+        b = trainer(make_mesh({"dp": 4, "ep": 2}))
+        assert ck.restore_latest(b) == 3
+        # restored params are bit-identical to the saved ones
+        for k, v in a._params.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(b._params[k]))
+        ls = [float(b.step(X, Y).asscalar()) for _ in range(2)]
+    assert all(np.isfinite(ls)), ls
