@@ -177,6 +177,25 @@ def _custom_arity(params):
     return len(_make_prop(params).list_outputs())
 
 
+def _pad_aux(ret, what, n_aux):
+    """CustomOpProp.infer_shape/infer_type may return (in, out) or
+    (in, out, aux) — the reference accepts both (operator.py:732-738,
+    :869-871). A prop that declares auxiliary states must return the
+    third element sized to match (reference asserts the same)."""
+    if len(ret) == 2:
+        ret = (ret[0], ret[1], [])
+    elif len(ret) != 3:
+        raise MXNetError(
+            "CustomOpProp.%s must return 2 or 3 lists, got %d" %
+            (what, len(ret)))
+    if len(ret[2]) != n_aux:
+        raise MXNetError(
+            "CustomOpProp.%s returned %d aux entries but "
+            "list_auxiliary_states() declares %d" %
+            (what, len(ret[2]), n_aux))
+    return ret
+
+
 def _as_struct(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
                                 np.dtype(dtype))
@@ -202,10 +221,12 @@ def _custom(*arrays, op_type=None, _mode="predict", **kwargs):
     aux_arrays = arrays[n_in:]
 
     in_shapes = [tuple(a.shape) for a in in_arrays]
-    ishapes, oshapes, _ashapes = prop.infer_shape(
-        [list(s) for s in in_shapes])
-    itypes, otypes, _atypes = prop.infer_type(
-        [np.dtype(a.dtype) for a in in_arrays])
+    ishapes, oshapes, _ashapes = _pad_aux(
+        prop.infer_shape([list(s) for s in in_shapes]), "infer_shape",
+        n_aux)
+    itypes, otypes, _atypes = _pad_aux(
+        prop.infer_type([np.dtype(a.dtype) for a in in_arrays]),
+        "infer_type", n_aux)
     out_structs = tuple(_as_struct(s, t) for s, t in zip(oshapes, otypes))
     in_structs = tuple(_as_struct(s, t) for s, t in zip(ishapes, itypes))
     op_inst = prop.create_operator(None, ishapes, itypes)
@@ -270,8 +291,11 @@ def _custom_shape_rule(ins, params, nodes):
     in_dtypes = [np.dtype(s.dtype) if s is not None else np.dtype("float32")
                  for s in ins]
     try:
-        ishapes, _o, _a = prop.infer_shape(in_shapes)
-        itypes, _ot, _at = prop.infer_type(in_dtypes)
+        n_aux = len(prop.list_auxiliary_states())
+        ishapes, _o, _a = _pad_aux(prop.infer_shape(in_shapes),
+                                   "infer_shape", n_aux)
+        itypes, _ot, _at = _pad_aux(prop.infer_type(in_dtypes),
+                                    "infer_type", n_aux)
     except (IndexError, KeyError):
         # the []-for-unknown-shape probe tripped the user's rule; leave
         # unresolved (real prop bugs surface on the concrete call)

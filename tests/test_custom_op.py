@@ -160,3 +160,51 @@ def test_custom_in_module_fit():
 def test_custom_unregistered_raises():
     with pytest.raises(mx.MXNetError):
         mx.nd.Custom(mx.nd.zeros((2,)), op_type="never_registered_xyz")
+
+
+def test_prop_infer_shape_may_omit_aux():
+    """The reference accepts a 2-tuple (in_shapes, out_shapes) from
+    CustomOpProp.infer_shape/infer_type — the form its own tutorial
+    uses (reference operator.py:732-738). Pin that a tutorial-style
+    prop works end-to-end."""
+    class Swish(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            z = in_data[0].asnumpy()
+            self.assign(out_data[0], req[0],
+                        mx.nd.array(z / (1 + np.exp(-z))))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            z = in_data[0].asnumpy()
+            s = 1 / (1 + np.exp(-z))
+            self.assign(in_grad[0], req[0],
+                        mx.nd.array(out_grad[0].asnumpy()
+                                    * (s + z * s * (1 - s))))
+
+    @mx.operator.register("tutorial_swish")
+    class SwishProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]   # NO aux element
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Swish()
+
+    x = mx.nd.array(np.linspace(-2, 2, 12).reshape(3, 4))
+    y = mx.nd.Custom(x, op_type="tutorial_swish")
+    z = np.asarray(x.asnumpy(), "float64")
+    np.testing.assert_allclose(y.asnumpy(),
+                               z / (1 + np.exp(-z)), rtol=1e-5)
+    # and through autograd
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Custom(x, op_type="tutorial_swish").sum()
+    out.backward()
+    s = 1 / (1 + np.exp(-z))
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               s + z * s * (1 - s), rtol=1e-4)
