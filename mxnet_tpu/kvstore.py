@@ -77,8 +77,15 @@ class KVStore:
         for k, v in zip(keys, values):
             if k in self._data:
                 raise MXNetError("key %r already initialized" % (k,))
-            self._data[k] = NDArray(v[0]._data if isinstance(v, (list, tuple))
-                                    else v._data)
+            val = v[0] if isinstance(v, (list, tuple)) else v
+            if getattr(val, "stype", "default") != "default":
+                # the store keeps a dense table whatever the init
+                # spelling: the reference documents initializing with
+                # an (often empty) row_sparse array
+                # (kvstore.py:146,222) — storing its values buffer
+                # alone would lose the table's dense shape
+                val = val.tostype("default")
+            self._data[k] = NDArray(val._data)
 
     def _after_merge(self, merged, key):
         """Hook between the local reduce and the store/update step;

@@ -149,3 +149,25 @@ class TestSparseDot:
         outT = sp.dot(rsp, nd.array(rng.randn(5, 3).astype("f")),
                       transpose_a=True)
         assert outT.shape == (4, 3)
+
+
+def test_init_with_row_sparse_value_keeps_table_shape():
+    """The reference's documented init spelling is a (possibly empty)
+    row_sparse array (reference kvstore.py:146,222); the store must
+    keep the full dense table shape, not the values buffer alone."""
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((8, 4)).tostype("row_sparse"))
+    g = row_sparse_array((np.ones((2, 4), "float32"), [2, 5]),
+                         shape=(8, 4))
+    kv.push("emb", g)
+    out = nd.zeros((8, 4)).tostype("row_sparse")
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([2, 5]))
+    got = out.tostype("default").asnumpy()
+    assert got.shape == (8, 4)
+    assert got[2].sum() != 0 and got[5].sum() != 0 and got[0].sum() == 0
+    # non-empty row_sparse init keeps the materialized rows too
+    kv2 = mx.kv.create("local")
+    kv2.init("w", nd.ones((4, 2)).tostype("row_sparse"))
+    dense = nd.zeros((4, 2))
+    kv2.pull("w", out=dense)
+    np.testing.assert_allclose(dense.asnumpy(), np.ones((4, 2)))
