@@ -103,9 +103,11 @@ class Executor:
             # reuse shared executor memory where shapes match (reference:
             # shared_exec bucketing path)
             if shared_exec is not None:
-                prev = shared_exec.arg_dict.get(name) or \
-                    shared_exec.aux_dict.get(name)
-                if prev is not None and prev.shape == tuple(s.shape):
+                prev = shared_exec.arg_dict.get(name)
+                if prev is None:  # `or` would call NDArray.__bool__,
+                    prev = shared_exec.aux_dict.get(name)  # which raises
+                if prev is not None and prev.shape == tuple(s.shape) \
+                        and np.dtype(prev.dtype) == np.dtype(s.dtype):
                     return prev
             return NDArray(jnp.zeros(s.shape, s.dtype), ctx)
 

@@ -273,6 +273,13 @@ class Module(BaseModule):
             # executors (reference: module.py:441)
             self._exec_group.set_params(self._arg_params,
                                         self._aux_params)
+        if shared_module is not None and \
+                shared_module.optimizer_initialized:
+            # a bucket bound AFTER init_optimizer must train with the
+            # shared module's optimizer (reference: module.py:454) —
+            # without this, BucketingModule.update() asserts on the
+            # first batch that lands in a fresh bucket
+            self.borrow_optimizer(shared_module)
 
     def _reset_bind(self):
         self.binded = False

@@ -124,3 +124,43 @@ def test_group2ctxs_raises_with_guidance():
     with pytest.raises(Exception, match="ShardedTrainer"):
         mx.mod.Module(net, label_names=None,
                       group2ctxs={"dev1": [mx.cpu()]})
+
+
+def test_bucketing_trains_into_fresh_bucket_after_init_optimizer():
+    """A bucket first encountered AFTER init_optimizer must bind
+    against the default bucket's executors (shared memory, no NDArray
+    truthiness) and borrow its optimizer (reference: module.py:454) —
+    the reference bucketing flow switches buckets lazily per batch."""
+    from mxnet_tpu.rnn import BucketSentenceIter
+    sentences = [[1, 2, 3], [4, 5], [7, 8, 9, 1],
+                 [1, 2, 3, 4, 5, 6], [2, 4, 6, 8, 1], [9, 8, 7, 6, 5, 4, 3]]
+    it = BucketSentenceIter(sentences, batch_size=2, buckets=[4, 8],
+                            invalid_label=0)
+
+    def sym_gen(seq_len):
+        d = mx.sym.var("data")
+        l = mx.sym.var("softmax_label")
+        e = mx.sym.Embedding(d, input_dim=10, output_dim=4,
+                             name="embed")
+        r = mx.sym.Reshape(e, shape=(-1, 4))
+        o = mx.sym.FullyConnected(r, num_hidden=10, name="pred")
+        lf = mx.sym.Reshape(l, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(o, lf, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    bm.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    keys_seen = set()
+    for epoch in range(2):
+        it.reset()
+        for batch in it:
+            bm.forward_backward(batch)
+            bm.update()
+            keys_seen.add(bm._curr_bucket_key)
+    assert keys_seen == {4, 8}, keys_seen
+    # the shared parameters actually moved
+    args, _ = bm.get_params()
+    assert float(np.abs(args["embed_weight"].asnumpy()).sum()) > 0
