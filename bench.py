@@ -357,9 +357,16 @@ def fence_child(p, graces=None):
     client and releases the device lease, where a blunt kill wedges it
     (PERF.md §9). Shared by the bench rungs and tools/probe_loop.py.
     Returns (stdout_so_far, signal_name|'unreaped') — output the child
-    printed before wedging is real and must be kept."""
+    printed before wedging is real and must be kept. stdout is always
+    str: TimeoutExpired.stdout is bytes even under text=True, so it is
+    decoded here — both callers can strip/concatenate without a
+    TypeError in exactly the wedge scenario they exist to survive."""
     import signal
     import subprocess
+
+    def _text(b):
+        return b.decode("utf-8", "replace") if isinstance(b, bytes) else b
+
     graces = graces or ((signal.SIGINT, 120), (signal.SIGTERM, 30),
                         (signal.SIGKILL, 30))
     out = None
@@ -367,11 +374,11 @@ def fence_child(p, graces=None):
         p.send_signal(sig)
         try:
             got, _ = p.communicate(timeout=grace)
-            return got if got is not None else out, \
-                signal.Signals(sig).name
+            return (_text(got) if got is not None else out,
+                    signal.Signals(sig).name)
         except subprocess.TimeoutExpired as e:
             if e.stdout is not None:
-                out = e.stdout
+                out = _text(e.stdout)
             continue
     return out, "unreaped"
 
@@ -400,12 +407,15 @@ def _run_rung(name, steps, unr, score, extras, deadline):
     except subprocess.TimeoutExpired as e:
         timed_out = True
         fenced, _sig = fence_child(p)
-        out = fenced if fenced is not None else (e.stdout or "")
+        if fenced is not None:
+            out = fenced
+        elif isinstance(e.stdout, bytes):
+            out = e.stdout.decode("utf-8", "replace")
+        else:
+            out = e.stdout or ""
 
     def parse():
-        text = out or ""
-        if isinstance(text, bytes):  # TimeoutExpired.stdout is bytes
-            text = text.decode("utf-8", "replace")  # even under text=True
+        text = out or ""  # always str: fence_child decodes
         lines = [l for l in text.splitlines()
                  if l.startswith("{")]
         if not lines:

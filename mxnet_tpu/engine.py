@@ -105,6 +105,20 @@ def host_engine(num_workers=None):
         return _host_engine
 
 
+def host_push(fn, const_vars=(), mutable_vars=()):
+    """Push host work (IO, decode, checkpoint writes) through the native
+    engine with the `engine.host_push` fault-injection site in front
+    (reference: Engine::Push, include/mxnet/engine.h:98). Runs `fn`
+    inline when the native lib isn't built, so callers need no
+    fallback branch of their own."""
+    from .resilience.chaos import chaos_point
+    chaos_point("engine.host_push")
+    eng = host_engine()
+    if eng is None:
+        return fn()
+    return eng.push(fn, list(const_vars), list(mutable_vars))
+
+
 def _waitall_native():
     """Drain the host engine if one exists (no-op otherwise); part of the
     nd.waitall() fence. Raises any exception captured by the engine's

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from .. import optimizer as opt
 from ..kvstore import create as _create_kvstore
+from ..resilience.atomic import atomic_write
+from ..resilience.preempt import at_step_boundary
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
@@ -121,6 +123,10 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: reduce grads, then update params
         (reference: trainer.py:241)."""
+        # step boundary: params/opt-state are consistent here, so a
+        # pending SIGTERM checkpoints and stops BEFORE new work starts
+        # (resilience/preempt.py)
+        at_step_boundary()
         self._ensure_ready()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._reduce()
@@ -173,7 +179,7 @@ class Trainer:
             self._kvstore.save_optimizer_states(fname,
                                                 dump_optimizer=True)
             return
-        with open(fname, "wb") as fout:
+        with atomic_write(fname) as fout:
             fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):

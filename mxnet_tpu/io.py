@@ -16,8 +16,10 @@ from collections import namedtuple
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .ndarray import NDArray, array as nd_array
+from .resilience.chaos import chaos_point
+from .resilience.retry import RetryPolicy, TransientError, retry_call
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "ImageRecordIter", "LibSVMIter",
@@ -93,7 +95,26 @@ class DataIter:
                              pad=self.getpad(), index=self.getindex())
         raise StopIteration
 
+    def _io_retry_policy(self):
+        # cached per iterator: env knobs don't change mid-epoch, and a
+        # fresh policy per batch would cost env lookups on the hot path
+        pol = getattr(self, "_io_retry_pol", None)
+        if pol is None:
+            pol = self._io_retry_pol = RetryPolicy(
+                max_attempts=getenv("MXTPU_IO_RETRIES", 8),
+                base_delay=getenv("MXTPU_RETRY_BASE_DELAY_S", 0.01),
+                max_delay=0.5, retry_on=(TransientError,), what="io.read")
+        return pol
+
     def __next__(self):
+        # `io.read` injection site: injected transient faults are
+        # absorbed (with backoff) BEFORE next() runs, so a chaos run
+        # sees the identical batch stream. Only the injection gate is
+        # retried — next() itself is never replayed: queue-backed
+        # iterators consume state per call, so a replay would skip a
+        # batch or turn a hard pipeline failure raised through next()
+        # into a silent early StopIteration.
+        retry_call(chaos_point, "io.read", policy=self._io_retry_policy())
         return self.next()
 
     def iter_next(self):

@@ -27,11 +27,12 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray import array
 from . import image as img_mod
 from . import recordio as rio
+from .resilience import metrics as _metrics
 
 __all__ = ["ImageRecordIter"]
 
@@ -51,7 +52,7 @@ class ImageRecordIter(DataIter):
                  round_batch=True, data_name="data",
                  label_name="softmax_label", layout="NCHW",
                  aug_list=None, dtype="float32", part_index=0,
-                 num_parts=1, **kwargs):
+                 num_parts=1, bad_record_budget=None, **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (C, H, W)")
@@ -78,6 +79,16 @@ class ImageRecordIter(DataIter):
         if layout not in ("NCHW", "NHWC"):
             raise MXNetError("layout must be NCHW or NHWC")
         self._dtype = np.dtype(dtype)
+        # corrupt-input budget (docs/fault_tolerance.md): records whose
+        # decode fails (torn JPEG, bad IRHeader) are skipped up to this
+        # count — cumulative across epochs — before the pipeline fails.
+        # `bad_record_count` is the monitoring counter. Default 0 keeps
+        # the reference's die-on-first-bad-record behavior.
+        if bad_record_budget is None:
+            bad_record_budget = getenv("MXTPU_BAD_RECORD_BUDGET", 0)
+        self._bad_budget = int(bad_record_budget)
+        self.bad_record_count = 0
+        self._bad_lock = threading.Lock()
 
         c, h, w = self._data_shape
         if aug_list is None:
@@ -139,6 +150,29 @@ class ImageRecordIter(DataIter):
             lbl = lbl[:self._label_width]
         return x, lbl
 
+    def _decode_safe(self, raw):
+        """Decode one record under the corrupt-input budget: a failing
+        record becomes None (skipped by the collator) while the budget
+        lasts, then fails the pipeline with the original error chained
+        (the error surfaces in next(), like every pipeline fault)."""
+        try:
+            return self._decode_one(raw)
+        except Exception as err:  # noqa: BLE001 — budget-gated below
+            with self._bad_lock:
+                self.bad_record_count += 1
+                nbad = self.bad_record_count
+            _metrics.bump("io.bad_records")
+            if nbad > self._bad_budget:
+                raise MXNetError(
+                    "corrupt record %d exceeds the bad-record budget "
+                    "of %d (MXTPU_BAD_RECORD_BUDGET) in %s: %s"
+                    % (nbad, self._bad_budget, self._path, err)) from err
+            import logging
+            logging.getLogger("mxnet_tpu.io").warning(
+                "%s: skipping corrupt record (%s), %d/%d budget used",
+                self._path, err, nbad, self._bad_budget)
+            return None
+
     def _assemble(self, q, stop, loader):
         # q/stop/loader arrive as arguments: a reset() that times out
         # waiting for this thread must not let it touch the NEW epoch's
@@ -153,8 +187,9 @@ class ImageRecordIter(DataIter):
 
             def emit(records):
                 nonlocal carry
-                samples = carry + list(self._pool.map(self._decode_one,
-                                                      records))
+                samples = carry + [
+                    s for s in self._pool.map(self._decode_safe, records)
+                    if s is not None]
                 while len(samples) >= self.batch_size:
                     chunk, samples = (samples[:self.batch_size],
                                       samples[self.batch_size:])

@@ -23,11 +23,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .ndarray import NDArray
 from . import optimizer as opt
+from .resilience.atomic import atomic_write
+from .resilience.chaos import chaos_point
+from .resilience.retry import RetryPolicy, TransientError, retry_call
 
 __all__ = ["KVStore", "create"]
+
+
+def _push_retry_policy():
+    """Push survives transient faults (chaos-injected or an explicitly
+    TransientError-raising transport) by re-running the whole per-key
+    push: the injection site sits before any mutation, so a retried
+    attempt recomputes from unchanged state. Only the explicit
+    TransientError contract is retried — an arbitrary mid-mutation
+    error is NOT safe to replay."""
+    return RetryPolicy(
+        max_attempts=getenv("MXTPU_KV_PUSH_RETRIES", 8),
+        base_delay=getenv("MXTPU_RETRY_BASE_DELAY_S", 0.02),
+        max_delay=1.0, retry_on=(TransientError,), what="kvstore.push")
 
 
 def _sum_arrays(vals):
@@ -92,38 +108,51 @@ class KVStore:
         DistKVStore adds the cross-process allreduce here."""
         return merged
 
+    def _push_policy(self):
+        pol = getattr(self, "_push_retry_pol", None)
+        if pol is None:  # cached per store: no env parse per key/step
+            pol = self._push_retry_pol = _push_retry_policy()
+        return pol
+
     def push(self, key, value, priority=0):
-        from .ndarray.sparse import RowSparseNDArray
         keys, values = _key_value(key, value)
+        policy = self._push_policy()
         for k, v in zip(keys, values):
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            if all(isinstance(a, RowSparseNDArray) for a in vals):
-                self._push_row_sparse(k, vals)
-                continue
-            if self._compression is not None and "dist" not in self.type \
-                    and self._compression.active_for(vals[0]._data):
-                # 'device' store: each device's addend is compressed before
-                # the reduce (the reference's compressed inter-device comm,
-                # comm.h); residual per (key, device slot). Dist stores
-                # compress at the wire instead (_after_merge).
-                merged = _sum_jnp([
-                    self._compression.roundtrip((k, i), a._data)
-                    for i, a in enumerate(vals)])
-            else:
-                merged = _sum_arrays(list(vals))
-            merged = self._after_merge(merged, k)
-            tgt = self._data[k]._data
-            if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
-                                                            None):
-                merged = jax.device_put(merged, tgt.sharding)
-            if self._updater is not None:
-                self._updater(_updater_key(k), NDArray(merged), self._data[k])
-            else:
-                # no updater: store the merged value (reference
-                # kvstore_local PushImpl copies the reduce result)
-                self._data[k]._data = merged
+            retry_call(self._push_one, k, v, policy=policy)
+
+    def _push_one(self, k, v):
+        """One key's push — the retry unit. `chaos_point` precedes all
+        mutation so a replay is idempotent."""
+        from .ndarray.sparse import RowSparseNDArray
+        chaos_point("kvstore.push")
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        if all(isinstance(a, RowSparseNDArray) for a in vals):
+            self._push_row_sparse(k, vals)
+            return
+        if self._compression is not None and "dist" not in self.type \
+                and self._compression.active_for(vals[0]._data):
+            # 'device' store: each device's addend is compressed before
+            # the reduce (the reference's compressed inter-device comm,
+            # comm.h); residual per (key, device slot). Dist stores
+            # compress at the wire instead (_after_merge).
+            merged = _sum_jnp([
+                self._compression.roundtrip((k, i), a._data)
+                for i, a in enumerate(vals)])
+        else:
+            merged = _sum_arrays(list(vals))
+        merged = self._after_merge(merged, k)
+        tgt = self._data[k]._data
+        if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
+                                                        None):
+            merged = jax.device_put(merged, tgt.sharding)
+        if self._updater is not None:
+            self._updater(_updater_key(k), NDArray(merged), self._data[k])
+        else:
+            # no updater: store the merged value (reference
+            # kvstore_local PushImpl copies the reduce result)
+            self._data[k]._data = merged
 
     def _push_row_sparse(self, k, vals):
         """Row-sparse push: only (indices, values) travel — never the
@@ -227,7 +256,9 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("there is no optimizer / updater")
-        with open(fname, "wb") as f:
+        # temp-file + os.replace: a kill mid-write never leaves a
+        # truncated .states blob (resilience/atomic.py)
+        with atomic_write(fname) as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

@@ -17,6 +17,7 @@ from .. import io as mx_io
 from ..model import BatchEndParam
 from ..initializer import Uniform
 from ..ndarray import NDArray
+from ..resilience.preempt import at_step_boundary
 
 
 _PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
@@ -260,6 +261,10 @@ class BaseModule:
                     monitor.tic()
                 self.forward_backward(batch)
                 self.update()
+                # step boundary: a pending SIGTERM checkpoints (via an
+                # active PreemptionGuard) and stops the fit loop here,
+                # after the update made state consistent
+                at_step_boundary()
                 if isinstance(batch, list):  # pre-sliced multi-device form
                     self.update_metric(eval_metric,
                                        [b.label for b in batch],

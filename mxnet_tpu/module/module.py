@@ -460,7 +460,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            from ..resilience.atomic import atomic_write
+            with atomic_write(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -684,9 +684,15 @@ def imdecode(buf, **kw):
 def save(fname, data):
     """Save arrays in the reference's binary .params container
     (reference: ndarray/utils.py:222 -> src/ndarray/ndarray.cc:1735);
-    files round-trip with the reference framework."""
+    files round-trip with the reference framework.
+
+    Crash-consistent: the bytes land in a same-directory temp file and
+    os.replace swings the name, so a process killed mid-save (the
+    preemption mode) never leaves a truncated .params blob. Covers
+    model.save_checkpoint, ParameterDict.save, save_parameters."""
     from .serialization import dumps
-    with open(fname, "wb") as f:
+    from ..resilience.atomic import atomic_write
+    with atomic_write(fname) as f:
         f.write(dumps(data))
 
 

@@ -19,11 +19,14 @@ Built on orbax (the JAX-ecosystem checkpoint library):
 from __future__ import annotations
 
 import os
+import warnings
 
 import jax
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
+from ..resilience.chaos import chaos_point
+from ..resilience.retry import RetryPolicy, TransientError, retry_call
 
 __all__ = ["TrainerCheckpoint"]
 
@@ -57,10 +60,25 @@ class TrainerCheckpoint:
     def save(self, step, trainer, wait=False):
         """Write a checkpoint for `step`. With async_save=True this
         returns once the on-device state is snapshotted; serialization
-        overlaps subsequent train steps (pass wait=True to block)."""
-        self._mngr.save(int(step),
-                        args=self._ocp.args.StandardSave(
-                            _state_of(trainer)))
+        overlaps subsequent train steps (pass wait=True to block).
+
+        Transient faults at the `checkpoint.save` injection site are
+        retried (the site precedes the orbax save, so a replay is
+        clean); MXTPU_CKPT_SAVE_RETRIES bounds the attempts."""
+        state = _state_of(trainer)
+
+        def _attempt():
+            chaos_point("checkpoint.save")
+            self._mngr.save(int(step),
+                            args=self._ocp.args.StandardSave(state))
+
+        pol = getattr(self, "_save_retry_pol", None)
+        if pol is None:
+            pol = self._save_retry_pol = RetryPolicy(
+                max_attempts=getenv("MXTPU_CKPT_SAVE_RETRIES", 5),
+                base_delay=getenv("MXTPU_RETRY_BASE_DELAY_S", 0.05),
+                retry_on=(TransientError,), what="checkpoint.save")
+        retry_call(_attempt, policy=pol)
         if wait:
             self._mngr.wait_until_finished()
 
@@ -205,11 +223,37 @@ class TrainerCheckpoint:
         return out
 
     def restore_latest(self, trainer):
-        """Restore the newest checkpoint; returns its step or None."""
-        step = self._mngr.latest_step()
-        if step is None:
+        """Restore the newest *readable* checkpoint; returns its step or
+        None when the directory holds no steps.
+
+        A preempted save or disk corruption can leave the newest step
+        unreadable; dying on it would strand a run whose older steps
+        are fine. Each failing step is skipped with a RuntimeWarning
+        naming it and the error; only when every step fails does the
+        last error propagate wrapped in a diagnosable MXNetError.
+        `restore(step, ...)` keeps strict single-step semantics —
+        restore() mutates the trainer only after full validation, so a
+        failed candidate leaves it untouched for the next one."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             return None
-        return self.restore(step, trainer)
+        last_err = None
+        for i, step in enumerate(steps):
+            try:
+                return self.restore(step, trainer)
+            except Exception as err:  # noqa: BLE001 — any unreadable
+                # step (truncated array file, torn metadata, orbax
+                # format error) falls through to the next-newest
+                last_err = err
+                if i + 1 < len(steps):
+                    warnings.warn(
+                        "checkpoint step %d in %s is unreadable (%s: "
+                        "%s); falling back to step %d"
+                        % (step, self._dir, type(err).__name__, err,
+                           steps[i + 1]), RuntimeWarning)
+        raise MXNetError(
+            "no readable checkpoint among steps %s in %s"
+            % (sorted(steps), self._dir)) from last_err
 
     def wait_until_finished(self):
         self._mngr.wait_until_finished()

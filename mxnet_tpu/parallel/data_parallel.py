@@ -36,6 +36,7 @@ from ..ndarray import NDArray
 from .. import symbol as _sym
 from ..graph import build_graph_fn, collect_vars
 from .. import random as _random
+from ..resilience.preempt import at_step_boundary
 from .mesh import make_mesh, replicated, current_mesh
 
 __all__ = ["ShardedTrainer", "sgd_init", "sgd_update", "adam_init",
@@ -414,6 +415,7 @@ class ShardedTrainer:
         if self._grad_compression is not None:
             raise MXNetError("step_many: not supported with gradient "
                              "compression; call step() per batch")
+        at_step_boundary()  # pending SIGTERM: checkpoint + stop here
         names = self._data_names + self._label_names
         if len(batch_and_labels) != len(names):
             raise MXNetError("step_many expects %s" % (names,))
@@ -598,6 +600,10 @@ class ShardedTrainer:
 
     def step(self, *batch_and_labels):
         """Run one fused train step; returns the scalar loss NDArray."""
+        # step boundary: state is consistent before new work begins, so
+        # a pending SIGTERM checkpoints and stops cleanly right here
+        # (resilience/preempt.py)
+        at_step_boundary()
         names = self._data_names + self._label_names
         if len(batch_and_labels) != len(names):
             raise MXNetError("step expects %s" % (names,))

@@ -22,12 +22,22 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..base import MXNetError, getenv
 from ..kvstore import KVStore
+from ..resilience.chaos import chaos_point, InjectedFailure
+from ..resilience.retry import (RetryPolicy, TransientError, retry_call,
+                                run_with_deadline)
 
 __all__ = ["DistKVStore", "init_distributed"]
 
 
 _dist_initialized = False
+
+
+class _AlreadyInitialized(MXNetError):
+    """jax's distributed runtime was initialized behind our back —
+    retrying would just repeat the same error and bury the real cause,
+    so the retry policy gives up on this immediately."""
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -39,6 +49,13 @@ def init_distributed(coordinator_address=None, num_processes=None,
     scheduler env before kv.create). No-op if already initialized or if
     no coordinator is configured (single-process run). Does NOT query
     backend state first — that would itself initialize the backends.
+
+    Transient coordinator failures (a peer restarting, the rendezvous
+    endpoint not yet up) are retried with exponential backoff
+    (MXTPU_DIST_INIT_RETRIES / MXTPU_DIST_INIT_BACKOFF_S), and each
+    attempt can be bounded by MXTPU_DIST_INIT_TIMEOUT_S so a dead
+    coordinator fails the attempt instead of hanging the process
+    forever (docs/fault_tolerance.md).
     """
     global _dist_initialized
     if _dist_initialized:
@@ -55,9 +72,34 @@ def init_distributed(coordinator_address=None, num_processes=None,
     if process_id is None:
         r = env.get("JAX_PROCESS_ID") or env.get("DMLC_WORKER_ID")
         process_id = int(r) if r else None
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    kwargs = {}
+    timeout = getenv("MXTPU_DIST_INIT_TIMEOUT_S", 0.0)
+    if timeout > 0:
+        kwargs["initialization_timeout"] = int(timeout)
+
+    def _attempt():
+        chaos_point("dist.init")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id, **kwargs)
+        except RuntimeError as err:
+            if "already initialized" in str(err).lower():
+                # a partially-successful earlier attempt (or foreign
+                # code) got there first: surface THAT, not N retries
+                # of the same complaint masking the root cause
+                raise _AlreadyInitialized(str(err)) from err
+            raise
+
+    retry_call(_attempt, policy=RetryPolicy(
+        max_attempts=getenv("MXTPU_DIST_INIT_RETRIES", 3),
+        base_delay=getenv("MXTPU_DIST_INIT_BACKOFF_S", 1.0),
+        max_delay=30.0,
+        retry_on=(TransientError, RuntimeError, ConnectionError, OSError,
+                  TimeoutError),
+        give_up_on=(InjectedFailure, _AlreadyInitialized),
+        what="dist.init"))
     _dist_initialized = True
 
 
@@ -226,7 +268,16 @@ class DistKVStore(KVStore):
                 jnp.asarray(ov.addressable_data(0)))
 
     def barrier(self):
-        """Global barrier (reference: kvstore.py Barrier → ps-lite)."""
+        """Global barrier (reference: kvstore.py Barrier → ps-lite).
+
+        Bounded by MXTPU_BARRIER_TIMEOUT_S (default 600): when a peer
+        dies mid-run the collective would otherwise block this process
+        forever (the round-5 wedge mode) — a diagnosable
+        DeadlineExceeded names the barrier and the budget instead."""
         if self._nproc > 1:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kv_barrier")
+            run_with_deadline(
+                lambda: multihost_utils.sync_global_devices(
+                    "mxnet_tpu_kv_barrier"),
+                getenv("MXTPU_BARRIER_TIMEOUT_S", 600.0),
+                what="kvstore barrier across %d processes" % self._nproc)

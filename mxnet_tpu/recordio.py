@@ -17,6 +17,11 @@ from collections import namedtuple
 
 import numpy as np
 
+from .base import getenv
+from .resilience import metrics as _metrics
+from .resilience.chaos import chaos_point
+from .resilience.retry import RetryPolicy, TransientError, retry_call
+
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
 
@@ -40,13 +45,25 @@ class MXRecordIO:
     libmxtpu.so is built, mirroring the reference's C++ RecordIO with a
     python fallback."""
 
-    def __init__(self, uri, flag):
+    def __init__(self, uri, flag, bad_record_budget=None):
         self.uri = uri
         self.flag = flag
         self.handle = None
         self.writable = None
         self.is_open = False
         self._nat = None
+        # corrupt-input budget (docs/fault_tolerance.md): up to this
+        # many MID-STREAM framing errors (bad magic) are skipped — the
+        # reader resyncs to the next 4-aligned magic word — before
+        # failing; a torn TRAILING record (crashed writer) is always
+        # treated as EOF, matching the pre-budget reader. Cumulative
+        # across reset(); surfaced in `bad_records` for monitoring.
+        # Default 0 keeps the reference's fail-on-first-corruption
+        # behavior for mid-stream damage.
+        if bad_record_budget is None:
+            bad_record_budget = getenv("MXTPU_BAD_RECORD_BUDGET", 0)
+        self._bad_budget = int(bad_record_budget)
+        self.bad_records = 0
         self.open()
 
     def open(self):
@@ -136,22 +153,106 @@ class MXRecordIO:
 
     def read(self):
         """Reads the next record; None at EOF
-        (reference: recordio.py:180)."""
+        (reference: recordio.py:180).
+
+        The python path carries the `io.read` injection site (retried:
+        the site precedes any stream consumption) and the corrupt-input
+        budget: framing errors resync to the next magic word while the
+        budget lasts."""
         assert not self.writable
         if self._nat is not None:
             return self._nat.read()
-        hdr = self.handle.read(8)
-        if len(hdr) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", hdr)
-        if magic != _kMagic:
-            raise IOError("Invalid RecordIO magic in %s" % self.uri)
-        length = lrec & _LENGTH_MASK
-        data = self.handle.read(length)
-        pad = (4 - (length % 4)) % 4
-        if pad:
-            self.handle.read(pad)
-        return data
+        # `io.read` injection site: only the gate is retried — the
+        # framing read below is not replayed (it consumes the stream)
+        retry_call(chaos_point, "io.read", policy=self._io_retry_policy())
+        return self._read_py()
+
+    def _io_retry_policy(self):
+        pol = getattr(self, "_io_retry_pol", None)
+        if pol is None:  # cached per reader: no env parse per record
+            pol = self._io_retry_pol = RetryPolicy(
+                max_attempts=getenv("MXTPU_IO_RETRIES", 8),
+                base_delay=getenv("MXTPU_RETRY_BASE_DELAY_S", 0.01),
+                max_delay=0.5, retry_on=(TransientError,), what="io.read")
+        return pol
+
+    def _read_py(self):
+        while True:
+            hdr_pos = self.handle.tell()
+            hdr = self.handle.read(8)
+            if len(hdr) == 0:
+                return None
+            if len(hdr) < 8:
+                # trailing garbage shorter than a header: a torn append
+                self._note_torn_tail("truncated header at byte %d"
+                                     % hdr_pos)
+                return None
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _kMagic:
+                self._count_bad("invalid magic at byte %d" % hdr_pos)
+                self._resync(hdr_pos + 1)
+                continue
+            length = lrec & _LENGTH_MASK
+            data = self.handle.read(length)
+            if len(data) < length:
+                # payload ran into EOF: a torn final record
+                self._note_torn_tail(
+                    "truncated record at byte %d (%d of %d payload "
+                    "bytes)" % (hdr_pos, len(data), length))
+                return None
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.handle.read(pad)
+            return data
+
+    def _note_torn_tail(self, what):
+        """A torn trailing record reads as EOF whatever the budget —
+        the pre-budget reader ended cleanly here too; the count and
+        warning just make the damage visible."""
+        self.bad_records += 1
+        _metrics.bump("io.bad_records")
+        import logging
+        logging.getLogger("mxnet_tpu.io").warning(
+            "%s: %s — treating as EOF (torn trailing record)",
+            self.uri, what)
+
+    def _count_bad(self, what):
+        """Account one mid-stream framing error against the budget;
+        raise when exhausted (the reference's behavior is budget 0)."""
+        self.bad_records += 1
+        _metrics.bump("io.bad_records")
+        if self.bad_records > self._bad_budget:
+            raise IOError(
+                "Invalid RecordIO magic in %s: %s (bad record %d "
+                "exceeds MXTPU_BAD_RECORD_BUDGET=%d)"
+                % (self.uri, what, self.bad_records, self._bad_budget))
+        import logging
+        logging.getLogger("mxnet_tpu.io").warning(
+            "%s: skipping corrupt record (%s), %d/%d budget used",
+            self.uri, what, self.bad_records, self._bad_budget)
+
+    def _resync(self, start):
+        """Scan forward from byte `start` for the next 4-aligned magic
+        word and position the handle there (records are 4-byte aligned
+        by the writer); lands at EOF when none is left."""
+        magic_bytes = struct.pack("<I", _kMagic)
+        pos = start
+        self.handle.seek(pos)
+        tail = b""
+        while True:
+            chunk = self.handle.read(65536)
+            if not chunk:
+                return
+            data = tail + chunk
+            base = pos - len(tail)
+            i = data.find(magic_bytes)
+            while i != -1:
+                if (base + i) % 4 == 0:
+                    self.handle.seek(base + i)
+                    return
+                i = data.find(magic_bytes, i + 1)
+            tail = data[-3:]
+            pos += len(chunk)
 
 
 class MXIndexedRecordIO(MXRecordIO):

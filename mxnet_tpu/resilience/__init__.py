@@ -1,0 +1,41 @@
+"""Resilience layer: fault injection, retry/deadline policies, and
+preemption-safe training (docs/fault_tolerance.md).
+
+The reference's only recovery story is "restart from checkpoint"
+(SURVEY.md §5.3-5.4); production TPU fleets additionally see transient
+coordinator failures, preemptions (SIGTERM with a grace window), torn
+host-side writes, and corrupt input records. This package supplies the
+missing machinery, wired through the runtime at named sites:
+
+- `chaos`:   seeded, env-driven fault injector (``MXTPU_CHAOS``) with
+             named sites (`kvstore.push`, `dist.init`, `checkpoint.save`,
+             `io.read`, `engine.host_push`) so tests and chaos runs can
+             trip failures deterministically (tools/chaos_run.py).
+- `retry`:   `RetryPolicy` / `retry()` / `retry_call()` with exponential
+             backoff + jitter, `Deadline` contexts, and
+             `run_with_deadline` (bounds calls that can hang forever —
+             the round-5 wedge mode).
+- `preempt`: `PreemptionGuard` turns SIGTERM/SIGINT into a synchronous
+             checkpoint save at the next step boundary plus a
+             diagnosable `TrainingPreempted`.
+- `atomic`:  `atomic_write` (temp file + os.replace) so a killed process
+             never leaves a truncated .params/.states blob.
+- `metrics`: process-wide counters (injected faults, skipped corrupt
+             records) surfaced for monitoring.
+"""
+from .retry import (RetryPolicy, retry, retry_call, Deadline,
+                    DeadlineExceeded, TransientError, run_with_deadline)
+from .chaos import (chaos_point, configure, reset, trip_count,
+                    parse_spec, InjectedFault, InjectedFailure)
+from .preempt import (PreemptionGuard, TrainingPreempted,
+                      at_step_boundary, preemption_requested)
+from .atomic import atomic_write
+from . import metrics
+from .metrics import counters
+
+__all__ = ["RetryPolicy", "retry", "retry_call", "Deadline",
+           "DeadlineExceeded", "TransientError", "run_with_deadline",
+           "chaos_point", "configure", "reset", "trip_count",
+           "parse_spec", "InjectedFault", "InjectedFailure",
+           "PreemptionGuard", "TrainingPreempted", "at_step_boundary",
+           "preemption_requested", "atomic_write", "metrics", "counters"]

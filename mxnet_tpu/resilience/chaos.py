@@ -1,0 +1,189 @@
+"""Seeded, env-driven fault injector (docs/fault_tolerance.md).
+
+Spec grammar (MXTPU_CHAOS)::
+
+    site:field=value,field=value[;site2:...]
+
+    MXTPU_CHAOS="kvstore.push:p=0.1,kind=raise;io.read:p=0.05"
+
+Fields per site:
+  p      probability a draw trips the fault            (default 1.0)
+  kind   raise  -> InjectedFault (a TransientError: retry-safe)
+         fatal  -> InjectedFailure (never retried)
+         sleep  -> time.sleep(secs) (exercises deadlines)  (default raise)
+  secs   sleep duration for kind=sleep                 (default 0.1)
+  n      stop tripping after n faults                  (default unlimited)
+  after  skip the first `after` draws                  (default 0)
+
+A site name ending in ``*`` prefix-matches (``kvstore.*``). Draws are
+deterministic: each site gets its own `random.Random` seeded from
+MXTPU_CHAOS_SEED (default 0) and the site name, so a chaos run replays
+bit-identically across processes and reruns.
+
+Injection sites wired through the runtime: `kvstore.push`, `dist.init`,
+`checkpoint.save`, `io.read`, `engine.host_push`. A `chaos_point(site)`
+call is free when no spec is configured (one dict lookup).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..base import MXNetError, getenv
+from .retry import TransientError
+from . import metrics
+
+__all__ = ["InjectedFault", "InjectedFailure", "parse_spec", "configure",
+           "reset", "chaos_point", "trip_count"]
+
+
+class InjectedFault(TransientError):
+    """A chaos-injected *transient* fault (kind=raise): the retry layer
+    is expected to absorb it."""
+
+
+class InjectedFailure(MXNetError):
+    """A chaos-injected *fatal* fault (kind=fatal): retry policies must
+    give up immediately and surface it."""
+
+
+_FIELDS = {"p": float, "secs": float, "n": int, "after": int, "kind": str}
+_KINDS = ("raise", "fatal", "sleep")
+
+
+def parse_spec(spec):
+    """Parse a MXTPU_CHAOS string into {site: field-dict}. Unknown
+    fields or kinds raise MXNetError naming the offender — a chaos run
+    with a typo'd spec silently injecting nothing is itself a failure
+    mode."""
+    out = {}
+    for part in filter(None, (p.strip() for p in (spec or "").split(";"))):
+        site, _, rest = part.partition(":")
+        site = site.strip()
+        if not site:
+            raise MXNetError("MXTPU_CHAOS entry %r lacks a site name"
+                             % part)
+        fields = {}
+        for field in filter(None, (f.strip() for f in rest.split(","))):
+            key, eq, val = field.partition("=")
+            key = key.strip()
+            if key not in _FIELDS or not eq:
+                raise MXNetError(
+                    "MXTPU_CHAOS site %r: unknown field %r (valid: %s)"
+                    % (site, field, ", ".join(sorted(_FIELDS))))
+            fields[key] = _FIELDS[key](val.strip())
+        kind = fields.get("kind", "raise")
+        if kind not in _KINDS:
+            raise MXNetError("MXTPU_CHAOS site %r: unknown kind %r "
+                             "(valid: %s)" % (site, kind,
+                                              ", ".join(_KINDS)))
+        out[site] = fields
+    return out
+
+
+class _Site:
+    """One armed injection site: seeded RNG, trip accounting."""
+
+    def __init__(self, name, fields, seed):
+        self.name = name
+        self.p = float(fields.get("p", 1.0))
+        self.kind = fields.get("kind", "raise")
+        self.secs = float(fields.get("secs", 0.1))
+        self.n = fields.get("n")
+        self.after = int(fields.get("after", 0))
+        self.rng = random.Random("%s:%s" % (seed, name))
+        self.draws = 0
+        self.trips = 0
+
+    def decide(self, at_site):
+        """Advance the draw/trip accounting and return the verdict:
+        None (no fault), a float (sleep that many seconds), or an
+        exception instance to raise. Runs under the injector lock; the
+        CALLER acts after releasing it, so a sleep fault never stalls
+        other threads' chaos points on the lock."""
+        self.draws += 1
+        if self.draws <= self.after:
+            return None
+        if self.n is not None and self.trips >= self.n:
+            return None
+        if self.rng.random() >= self.p:
+            return None
+        self.trips += 1
+        metrics.bump("chaos.injected.%s" % at_site)
+        if self.kind == "sleep":
+            return self.secs
+        cls = InjectedFailure if self.kind == "fatal" else InjectedFault
+        return cls("[chaos] injected %s fault at %r (trip %d, draw %d, "
+                   "spec site %r)" % (self.kind, at_site, self.trips,
+                                      self.draws, self.name))
+
+
+_lock = threading.Lock()
+# None => lazily (re)load from MXTPU_CHAOS at the next chaos_point
+_state = {"exact": None, "prefix": []}
+
+
+def configure(spec=None, seed=None):
+    """Arm the injector programmatically (tests) or from the env
+    (spec=None re-reads MXTPU_CHAOS). An empty spec disarms."""
+    if spec is None:
+        spec = os.environ.get("MXTPU_CHAOS", "")
+    if seed is None:
+        seed = getenv("MXTPU_CHAOS_SEED", 0)
+    parsed = parse_spec(spec)
+    with _lock:
+        _state["exact"] = {}
+        _state["prefix"] = []
+        for name, fields in parsed.items():
+            site = _Site(name, fields, seed)
+            if name.endswith("*"):
+                _state["prefix"].append((name[:-1], site))
+            else:
+                _state["exact"][name] = site
+
+
+def reset():
+    """Disarm and forget; the next chaos_point re-reads the env."""
+    with _lock:
+        _state["exact"] = None
+        _state["prefix"] = []
+
+
+def _lookup(site):
+    exact = _state["exact"]
+    if exact is None:
+        configure()
+        exact = _state["exact"]
+    sp = exact.get(site)
+    if sp is not None:
+        return sp
+    for prefix, psite in _state["prefix"]:
+        if site.startswith(prefix):
+            return psite
+    return None
+
+
+def chaos_point(site):
+    """Declare a named injection site. No-op (one dict lookup) unless a
+    chaos spec arms this site; then a seeded draw may raise
+    InjectedFault/InjectedFailure or sleep, per the spec."""
+    sp = _lookup(site)
+    if sp is None:
+        return
+    with _lock:
+        verdict = sp.decide(site)
+    if verdict is None:
+        return
+    if isinstance(verdict, float):
+        time.sleep(verdict)
+        return
+    raise verdict
+
+
+def trip_count(site):
+    """How many times `site` has actually tripped (for assertions and
+    monitoring; also mirrored in metrics.counters)."""
+    sp = _lookup(site)
+    return 0 if sp is None else sp.trips

@@ -1,0 +1,30 @@
+"""Process-wide resilience counters, surfaced for monitoring.
+
+Incremented by the chaos injector (`chaos.injected.<site>`), the
+corrupt-record budget (`io.bad_records`), and retry loops
+(`retry.attempts.<what>`). Scrape with `counters` / `get`; tests call
+`reset_counters()` between cases.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+__all__ = ["counters", "bump", "get", "reset_counters"]
+
+_lock = threading.Lock()
+counters = collections.defaultdict(int)
+
+
+def bump(name, n=1):
+    with _lock:
+        counters[name] += n
+
+
+def get(name):
+    return counters.get(name, 0)
+
+
+def reset_counters():
+    with _lock:
+        counters.clear()

@@ -187,6 +187,50 @@ def test_old_plain_sgd_checkpoint_restores_into_stateless_trainer(
                                        rtol=1e-6, atol=1e-7)
 
 
+def test_restore_latest_falls_back_past_corrupt_step(tmp_path):
+    """A preempted save / disk corruption can leave the newest step
+    unreadable; restore_latest must warn, fall back to the newest
+    READABLE step, and report that step's number — not die on the
+    corpse (resilience layer, docs/fault_tolerance.md)."""
+    import os
+    rng = np.random.RandomState(7)
+    net = _net()
+    mesh = make_mesh({"dp": 8})
+    x, y = _batch(rng)
+    a = _trainer(net, mesh)
+    with TrainerCheckpoint(tmp_path / "ck", max_to_keep=3) as ck:
+        for s in (1, 2):
+            a.step(x, y)
+            ck.save(s, a, wait=True)
+        good = {k: np.asarray(v).copy() for k, v in a._params.items()}
+        a.step(x, y)
+        ck.save(3, a, wait=True)
+
+        # corrupt every data file of the newest step (keep the
+        # step-level metadata so orbax still lists the step)
+        step_dir = str(tmp_path / "ck" / "3")
+        assert os.path.isdir(step_dir)
+        clobbered = 0
+        for root, _dirs, files in os.walk(step_dir):
+            for fn in files:
+                if fn == "_CHECKPOINT_METADATA":
+                    continue
+                with open(os.path.join(root, fn), "wb") as f:
+                    f.write(b"\x00garbage\x00" * 16)
+                clobbered += 1
+        assert clobbered > 0
+        assert ck.latest_step() == 3  # still listed — that's the trap
+
+        b = _trainer(net, mesh)
+        with pytest.warns(RuntimeWarning, match="step 3 .* unreadable"):
+            restored = ck.restore_latest(b)
+        assert restored == 2
+        assert b._step_count == 2
+        for k in good:
+            np.testing.assert_allclose(np.asarray(b._params[k]),
+                                       good[k], rtol=1e-6, atol=1e-7)
+
+
 def test_elastic_restore_onto_smaller_world(tmp_path):
     """Elasticity beyond the reference: save from a dp=8 mesh, resume on
     a dp=4 mesh (half the devices). The training math is world-size

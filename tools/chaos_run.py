@@ -1,0 +1,107 @@
+"""Chaos wrapper: run any training command under a fault-injection spec
+and assert it either completes or exits with a clean, diagnosable error
+— never hangs (docs/fault_tolerance.md).
+
+The wrapped command gets MXTPU_CHAOS / MXTPU_CHAOS_SEED in its
+environment; the resilience layer's injection sites do the rest. A
+watchdog bounds the run: on deadline the child is reaped with the
+SIGINT-first escalation ladder shared with bench.py (a blunt kill can
+wedge a device lease, PERF.md §9), and the outcome is HANG — always a
+failure, whatever --expect says, because a hang is the one mode the
+resilience layer promises to have eliminated.
+
+Usage:
+    python tools/chaos_run.py --chaos "kvstore.push:p=0.1,kind=raise" \
+        [--seed 7] [--timeout 900] [--expect complete|error|either] \
+        -- python train.py ...
+
+Exit codes: 0 outcome matched --expect; 2 outcome mismatched; 3 hang.
+Runnable from the bench harness (plain argv contract, single JSON
+summary line on stdout).
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import fence_child  # noqa: E402 — shared reaping ladder
+
+
+def classify(rc, tail):
+    """COMPLETED on rc 0; CLEAN_ERROR when a nonzero exit left a
+    readable reason in the output tail; DIRTY_ERROR when it died mute
+    (undiagnosable — treated like a mismatch, not like CLEAN_ERROR)."""
+    if rc == 0:
+        return "COMPLETED"
+    return "CLEAN_ERROR" if tail.strip() else "DIRTY_ERROR"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="run a command under MXTPU_CHAOS with a no-hang "
+                    "watchdog")
+    ap.add_argument("--chaos", required=True,
+                    help="MXTPU_CHAOS spec, e.g. "
+                         "'kvstore.push:p=0.1,kind=raise;io.read:p=0.05'")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="MXTPU_CHAOS_SEED for the child (default 0)")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="watchdog deadline in seconds")
+    ap.add_argument("--grace", type=float, default=20.0,
+                    help="per-signal reap grace after the deadline")
+    ap.add_argument("--expect", choices=("complete", "error", "either"),
+                    default="either",
+                    help="assertion: the run must complete, must fail "
+                         "cleanly, or either (default) — a hang always "
+                         "fails")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- command to run")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no command given (put it after --)")
+
+    # validate the spec HERE: a typo'd spec silently injecting nothing
+    # would report a meaningless pass
+    from mxnet_tpu.resilience.chaos import parse_spec
+    sites = sorted(parse_spec(args.chaos))
+
+    env = dict(os.environ,
+               MXTPU_CHAOS=args.chaos,
+               MXTPU_CHAOS_SEED=str(args.seed))
+    t0 = time.time()
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    hung = False
+    try:
+        out, _ = p.communicate(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        hung = True
+        g = args.grace
+        out, _sig = fence_child(p, graces=((signal.SIGINT, g),
+                                           (signal.SIGTERM, g),
+                                           (signal.SIGKILL, g)))
+    tail = "\n".join((out or "").splitlines()[-15:])
+    outcome = "HANG" if hung else classify(p.returncode, tail)
+
+    ok = {"complete": outcome == "COMPLETED",
+          "error": outcome == "CLEAN_ERROR",
+          "either": outcome in ("COMPLETED", "CLEAN_ERROR")}[args.expect]
+    print(json.dumps({"outcome": outcome, "ok": ok,
+                      "rc": p.returncode, "hung": hung,
+                      "elapsed_s": round(time.time() - t0, 2),
+                      "chaos_sites": sites,
+                      "tail": tail[-2000:]}))
+    if outcome == "HANG":
+        return 3
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,9 @@ def _fenced_probe(timeout_s):
     AFTER the relay granted the lease, a clean KeyboardInterrupt
     unwind releases it, where a blunt SIGKILL would wedge it
     (develop_and_hack.md rule 7). Returns (stdout, stderr_tail,
-    status) — stdout the child printed before wedging is kept."""
+    status) — stdout the child printed before wedging is kept, and is
+    always str (fence_child decodes TimeoutExpired's bytes buffer, so
+    the log-append below never TypeErrors on bytes)."""
     import signal
     p = subprocess.Popen([sys.executable, "-c", PROBE],
                          stdout=subprocess.PIPE,
