@@ -51,6 +51,7 @@ from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import recordio
 from . import gluon
 from . import parallel
+from . import observability
 from . import resilience
 from . import test_utils
 from . import monitor

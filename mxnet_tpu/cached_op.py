@@ -18,10 +18,20 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .graph import build_graph_fn, collect_vars
 from .ndarray import NDArray
+from .observability import registry as _obs
 from . import autograd
 from . import random as _random
 
 __all__ = ["CachedOp"]
+
+# jit-wrapper builds per (op, mode, direction). Each build retraces the
+# graph and usually triggers an XLA backend compile — the per-compile
+# truth (count + seconds, including per-shape recompiles inside one
+# wrapper) is xla.compile.* via the jax.monitoring listener
+# (observability/telemetry.py); this counter attributes WHICH CachedOp
+# keeps rebuilding.
+_JIT_BUILDS = _obs.counter("cachedop.jit.builds",
+                           "jit wrapper constructions by CachedOp")
 
 
 class _GraphOpStub:
@@ -51,12 +61,14 @@ class CachedOp:
 
     def _fwd(self, mode):
         if mode not in self._fwd_jits:
+            _JIT_BUILDS.inc(op=self._stub.name, mode=mode, direction="fwd")
             fn, _, _, needs_rng = build_graph_fn(self._symbol._entries, mode)
             self._fwd_jits[mode] = (jax.jit(fn), needs_rng)
         return self._fwd_jits[mode]
 
     def _bwd(self, mode):
         if mode not in self._bwd_jits:
+            _JIT_BUILDS.inc(op=self._stub.name, mode=mode, direction="bwd")
             fn, _, _, _ = build_graph_fn(self._symbol._entries, mode)
 
             def bwd(args, aux, key, cots):

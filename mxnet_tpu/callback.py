@@ -13,6 +13,17 @@ import logging
 import sys
 import time
 
+from .observability import registry as _obs
+
+# scrapeable throughput (docs/observability.md): Speedometer's log lines
+# were the only place samples/sec existed; now every report also lands
+# in these metrics, labeled by the callback's metric window
+_SPEED_GAUGE = _obs.gauge("train.samples_per_sec",
+                          "Most recent Speedometer throughput reading")
+_BATCH_SECONDS = _obs.histogram(
+    "train.batch.seconds",
+    "Per-batch latency averaged over each Speedometer window")
+
 
 def _every(period):
     """True on epochs/batches 1·p, 2·p, ... (1-based)."""
@@ -106,6 +117,9 @@ class Speedometer:
         speed = self._meter.observe(count)
         if speed is None:
             return
+        _SPEED_GAUGE.set(speed)
+        if speed > 0:
+            _BATCH_SECONDS.observe(self.batch_size / speed)
         pairs = _metric_pairs(param)
         if pairs:
             if self.auto_reset:

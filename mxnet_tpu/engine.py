@@ -105,6 +105,21 @@ def host_engine(num_workers=None):
         return _host_engine
 
 
+def _host_queue_gauge():
+    """Lazy gauge: engine imports before the observability package in
+    mxnet_tpu/__init__, so binding at call time keeps import order
+    flexible; the instance is cached after the first push."""
+    global _host_depth
+    if _host_depth is None:
+        from .observability.registry import gauge
+        _host_depth = gauge("engine.host_queue.depth",
+                            "Host-engine ops pushed but not yet completed")
+    return _host_depth
+
+
+_host_depth = None
+
+
 def host_push(fn, const_vars=(), mutable_vars=()):
     """Push host work (IO, decode, checkpoint writes) through the native
     engine with the `engine.host_push` fault-injection site in front
@@ -114,9 +129,26 @@ def host_push(fn, const_vars=(), mutable_vars=()):
     from .resilience.chaos import chaos_point
     chaos_point("engine.host_push")
     eng = host_engine()
+    depth = _host_queue_gauge()
+    depth.inc()
     if eng is None:
-        return fn()
-    return eng.push(fn, list(const_vars), list(mutable_vars))
+        try:
+            return fn()
+        finally:
+            depth.dec()
+
+    def _tracked():
+        try:
+            fn()
+        finally:
+            depth.dec()
+
+    try:
+        return eng.push(_tracked, list(const_vars), list(mutable_vars))
+    except BaseException:
+        # enqueue itself failed: _tracked will never run its dec
+        depth.dec()
+        raise
 
 
 def _waitall_native():

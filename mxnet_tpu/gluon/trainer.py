@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from .. import optimizer as opt
 from ..kvstore import create as _create_kvstore
+from ..observability.telemetry import StepTimer
 from ..resilience.atomic import atomic_write
 from ..resilience.preempt import at_step_boundary
 from .parameter import ParameterDict, Parameter
@@ -63,6 +64,7 @@ class Trainer:
         self._ready = False
         self._optimizer = self._make_optimizer(optimizer, opt_kw)
         self._updaters = [opt.get_updater(self._optimizer)]
+        self._telemetry = StepTimer("gluon.trainer")
 
     # -- construction ---------------------------------------------------
     def _make_optimizer(self, optimizer, opt_kw):
@@ -128,9 +130,14 @@ class Trainer:
         # (resilience/preempt.py)
         at_step_boundary()
         self._ensure_ready()
+        tel = self._telemetry
+        tel.begin_step()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._reduce()
-        self._apply_updates()
+        with tel.phase("allreduce"):
+            self._reduce()
+        with tel.phase("optimizer"):
+            self._apply_updates()
+        tel.end_step(batch_size=batch_size)
 
     def allreduce_grads(self):
         """Reduce gradients over devices/workers without updating

@@ -12,14 +12,28 @@ across the epoch (pad_last_batch / roll-over) avoids XLA recompiles.
 from __future__ import annotations
 
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from .base import MXNetError, getenv
 from .ndarray import NDArray, array as nd_array
+from .observability import registry as _obs
+from .observability.telemetry import is_producer_thread
 from .resilience.chaos import chaos_point
 from .resilience.retry import RetryPolicy, TransientError, retry_call
+
+# consumer-side data-stall telemetry: how long next() blocked before a
+# batch was ready. StepTimer reads this histogram's running sum at step
+# boundaries to attribute data_wait per training step. Pulls made from
+# prefetch *producer* threads overlap with compute, so they count as
+# assembly time instead of consumer stall.
+_BATCH_WAIT = _obs.histogram("io.batch_wait.seconds",
+                             "Time the consumer blocked waiting for a batch")
+_BATCH_ASSEMBLE = _obs.histogram(
+    "io.batch_assemble.seconds",
+    "Batch pull/assembly time on prefetch producer threads")
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "ImageRecordIter", "LibSVMIter",
@@ -115,7 +129,11 @@ class DataIter:
         # batch or turn a hard pipeline failure raised through next()
         # into a silent early StopIteration.
         retry_call(chaos_point, "io.read", policy=self._io_retry_policy())
-        return self.next()
+        t0 = time.perf_counter()
+        batch = self.next()
+        hist = _BATCH_ASSEMBLE if is_producer_thread() else _BATCH_WAIT
+        hist.observe(time.perf_counter() - t0)
+        return batch
 
     def iter_next(self):
         raise NotImplementedError

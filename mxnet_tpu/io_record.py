@@ -32,9 +32,20 @@ from .io import DataIter, DataBatch, DataDesc
 from .ndarray import array
 from . import image as img_mod
 from . import recordio as rio
+from .observability import registry as _obs
 from .resilience import metrics as _metrics
 
 __all__ = ["ImageRecordIter"]
+
+# pipeline-health telemetry: queue depth ~0 while the consumer is
+# waiting means the decode pool can't keep up (raise preprocess_threads
+# / prefetch_buffer); depth pinned at capacity means the accelerator is
+# the bottleneck
+_QUEUE_DEPTH = _obs.gauge("io.record.queue_depth",
+                          "Ready batches in the ImageRecordIter prefetch "
+                          "queue, sampled at each consumer pull")
+_BATCHES = _obs.counter("io.record.batches",
+                        "Batches served by ImageRecordIter")
 
 
 class ImageRecordIter(DataIter):
@@ -248,6 +259,7 @@ class ImageRecordIter(DataIter):
         if self._exhausted:
             raise StopIteration  # repeatedly, like the reference; a
             # blocking get() here would deadlock (no producer alive)
+        _QUEUE_DEPTH.set(self._q.qsize())
         item = self._q.get()
         if item is None:
             self._exhausted = True
@@ -255,6 +267,7 @@ class ImageRecordIter(DataIter):
         if isinstance(item, Exception):
             self._exhausted = True
             raise item
+        _BATCHES.inc()
         return item
 
     def _drain(self):

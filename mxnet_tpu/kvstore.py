@@ -18,6 +18,7 @@ Module and Gluon Trainer drive it unchanged:
 from __future__ import annotations
 
 import pickle
+import time
 
 import jax
 import jax.numpy as jnp
@@ -26,11 +27,45 @@ import numpy as np
 from .base import MXNetError, getenv
 from .ndarray import NDArray
 from . import optimizer as opt
+from .observability import registry as _obs
 from .resilience.atomic import atomic_write
 from .resilience.chaos import chaos_point
 from .resilience.retry import RetryPolicy, TransientError, retry_call
 
 __all__ = ["KVStore", "create"]
+
+# wire/latency telemetry (docs/observability.md): bytes are the local
+# payload sizes entering the store; the dist allreduce wire bytes are
+# counted separately in parallel/kvstore_dist.py
+_PUSH_BYTES = _obs.counter("kvstore.push.bytes",
+                           "Gradient bytes pushed into the kvstore")
+_PUSH_CALLS = _obs.counter("kvstore.push.calls")
+_PUSH_SECONDS = _obs.histogram("kvstore.push.seconds",
+                               "Wall time of one push() call (all keys)")
+_PULL_BYTES = _obs.counter("kvstore.pull.bytes",
+                           "Parameter bytes pulled out of the kvstore")
+_PULL_CALLS = _obs.counter("kvstore.pull.calls")
+_PULL_SECONDS = _obs.histogram("kvstore.pull.seconds",
+                               "Wall time of one pull() call (all keys)")
+
+
+def _nbytes(value):
+    """Payload bytes of a push/pull value: an NDArray, a list of them,
+    or a row-sparse array (counts its (indices, values) wire form)."""
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    total = 0
+    for attr in ("_indices", "_values"):
+        part = getattr(value, attr, None)
+        if part is not None:
+            d = part._data
+            total += int(d.size) * d.dtype.itemsize
+    if total:
+        return total
+    d = getattr(value, "_data", None)
+    if d is None:
+        return 0
+    return int(d.size) * d.dtype.itemsize
 
 
 def _push_retry_policy():
@@ -117,10 +152,16 @@ class KVStore:
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         policy = self._push_policy()
+        t0 = time.perf_counter()
+        nbytes = 0
         for k, v in zip(keys, values):
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
+            nbytes += _nbytes(v)
             retry_call(self._push_one, k, v, policy=policy)
+        _PUSH_BYTES.inc(nbytes)
+        _PUSH_CALLS.inc()
+        _PUSH_SECONDS.observe(time.perf_counter() - t0)
 
     def _push_one(self, k, v):
         """One key's push — the retry unit. `chaos_point` precedes all
@@ -190,13 +231,19 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)
+        t0 = time.perf_counter()
+        nbytes = 0
         for k, o in zip(keys, outs):
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
             targets = o if isinstance(o, (list, tuple)) else [o]
             src = self._data[k]._data
+            nbytes += int(src.size) * src.dtype.itemsize * len(targets)
             for t in targets:
                 t._data = src
+        _PULL_BYTES.inc(nbytes)
+        _PULL_CALLS.inc()
+        _PULL_SECONDS.observe(time.perf_counter() - t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference: kvstore.py:312,

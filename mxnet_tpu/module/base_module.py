@@ -17,6 +17,7 @@ from .. import io as mx_io
 from ..model import BatchEndParam
 from ..initializer import Uniform
 from ..ndarray import NDArray
+from ..observability.telemetry import StepTimer
 from ..resilience.preempt import at_step_boundary
 
 
@@ -200,12 +201,13 @@ class BaseModule:
         ################################################################
         # training loop (reference role: base_module.py:491-560)
         ################################################################
+        step_timer = StepTimer("module.fit")
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
             eval_metric.reset()
             final_metrics = self._run_train_epoch(
                 train_data, epoch, eval_metric, monitor,
-                batch_end_callback, sparse_row_id_fn)
+                batch_end_callback, sparse_row_id_fn, step_timer)
 
             for name, val in final_metrics:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -230,7 +232,8 @@ class BaseModule:
             train_data.reset()
 
     def _run_train_epoch(self, train_data, epoch, eval_metric, monitor,
-                         batch_end_callback, sparse_row_id_fn):
+                         batch_end_callback, sparse_row_id_fn,
+                         step_timer=None):
         """One epoch of the fit loop, with one-batch lookahead: prepare()
         sees batch k+1 while the device still works on k, and the last
         batch is known as such before its callbacks run."""
@@ -259,8 +262,13 @@ class BaseModule:
                     self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(batch)
-                self.update()
+                if step_timer is None:
+                    step_timer = StepTimer("module.fit")
+                step_timer.begin_step()
+                with step_timer.phase("forward_backward"):
+                    self.forward_backward(batch)
+                with step_timer.phase("optimizer"):
+                    self.update()
                 # step boundary: a pending SIGTERM checkpoints (via an
                 # active PreemptionGuard) and stops the fit loop here,
                 # after the update made state consistent
@@ -273,6 +281,9 @@ class BaseModule:
                     self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                step_timer.end_step(
+                    batch_size=getattr(train_data, "batch_size", None),
+                    epoch=epoch, nbatch=nbatch)
                 if is_last:
                     # read before batch callbacks, which may reset metrics
                     final_metrics = eval_metric.get_name_value()

@@ -1,0 +1,31 @@
+"""Unified runtime observability (docs/observability.md).
+
+Three pieces, one namespace:
+
+- `registry`:  thread-safe Counter/Gauge/Histogram metrics with labels,
+               exported as Prometheus text or JSONL. Absorbs the old
+               `resilience.metrics` counters (kept as a shim).
+- `span`:      host span tracing with thread-local parent propagation;
+               events merge into the profiler's chrome-trace stream so
+               host spans, eager ops, and the device trace share one
+               timeline.
+- `telemetry`: per-step training records (StepTimer) streamed as JSONL
+               when ``MXTPU_TELEMETRY=<path>`` is set, plus the
+               process-wide XLA-compile listener. Summarize with
+               `tools/telemetry_report.py`.
+
+Counters ship ON by default (near-free); JSONL step streaming ships OFF
+(one env check per step).
+"""
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       REGISTRY, counter, gauge, histogram,
+                       DEFAULT_BUCKETS)
+from .span import span, current_span
+from .telemetry import (StepTimer, stream_path, stream_enabled, emit,
+                        close_stream)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
+           "span", "current_span",
+           "StepTimer", "stream_path", "stream_enabled", "emit",
+           "close_stream"]

@@ -1,0 +1,325 @@
+"""Thread-safe metrics registry: Counter / Gauge / Histogram with labels.
+
+The single sink for every runtime counter in the framework (the role
+TensorFlow's monitoring core and the reference's profiler aggregate
+table split between them): chaos injections and retry loops
+(resilience/metrics.py is a shim over this registry), kvstore wire
+traffic, input-pipeline batch waits, XLA compile stalls, and training
+throughput all land here, in one namespace, exportable in Prometheus
+text format (`to_prometheus`) and JSONL (`to_jsonl`).
+
+Naming scheme (docs/observability.md): dotted lowercase components with
+a unit suffix — `kvstore.push.bytes`, `io.batch_wait.seconds`,
+`xla.compile.count`. Prometheus export maps dots to underscores and
+prefixes `mxtpu_` (counters additionally get `_total`), so
+`kvstore.push.bytes` scrapes as `mxtpu_kvstore_push_bytes_total`.
+
+Counters are on by default and cheap (one lock + dict add per bump at
+batch/step granularity, never per element); JSONL *streaming* of step
+records is separately gated by MXTPU_TELEMETRY (telemetry.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "DEFAULT_BUCKETS"]
+
+_INF = float("inf")
+
+# latency-oriented default: 0.5ms .. 60s, roughly x2.5 per step
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, _INF)
+
+
+def _label_key(labels):
+    """Canonical hashable key for a label kwargs dict."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _prom_name(name):
+    san = name.replace(".", "_").replace("-", "_").replace("/", "_")
+    return san if san.startswith("mxtpu_") else "mxtpu_" + san
+
+
+def _prom_labels(key):
+    if not key:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, v) for k, v in key)
+
+
+class _Metric:
+    """Common labeled-sample storage; subclasses define the sample type."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values = {}
+
+    def labelsets(self):
+        with self._lock:
+            return list(self._values.keys())
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Counter(_Metric):
+    """Monotonically increasing value (float-valued: compile *seconds*
+    accumulate here too, not just event counts)."""
+
+    kind = "counter"
+
+    def inc(self, n=1, **labels):
+        if n < 0:
+            raise ValueError("Counter %r cannot decrease (got %r)"
+                             % (self.name, n))
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def get(self, **labels):
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+    def total(self):
+        """Sum across every labelset."""
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge(_Metric):
+    """Point-in-time value that can move both ways (queue depths,
+    samples/sec)."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+    def inc(self, n=1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def dec(self, n=1, **labels):
+        self.inc(-n, **labels)
+
+    def get(self, **labels):
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; +Inf bucket == count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds or bounds[-1] != _INF:
+            bounds = bounds + (_INF,)
+        self.buckets = bounds
+
+    def _cell(self, key):
+        cell = self._values.get(key)
+        if cell is None:
+            cell = self._values[key] = {
+                "counts": [0] * len(self.buckets), "sum": 0.0, "count": 0}
+        return cell
+
+    def observe(self, value, **labels):
+        value = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            cell = self._cell(key)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    cell["counts"][i] += 1
+                    break
+            cell["sum"] += value
+            cell["count"] += 1
+
+    def sum(self, **labels):
+        with self._lock:
+            cell = self._values.get(_label_key(labels))
+            return cell["sum"] if cell else 0.0
+
+    def count(self, **labels):
+        with self._lock:
+            cell = self._values.get(_label_key(labels))
+            return cell["count"] if cell else 0
+
+    def total_sum(self):
+        with self._lock:
+            return sum(c["sum"] for c in self._values.values())
+
+    def total_count(self):
+        with self._lock:
+            return sum(c["count"] for c in self._values.values())
+
+    def percentile(self, q, **labels):
+        """Bucket-interpolated quantile estimate in [0, 1] (exact
+        quantiles of raw step records come from tools/telemetry_report.py
+        over the JSONL stream; this is the scrape-time approximation)."""
+        with self._lock:
+            cell = self._values.get(_label_key(labels))
+            if not cell or not cell["count"]:
+                return 0.0
+            counts = list(cell["counts"])
+            total = cell["count"]
+        rank = q * total
+        cum = 0
+        lo = 0.0
+        for i, n in enumerate(counts):
+            hi = self.buckets[i]
+            if cum + n >= rank:
+                if hi == _INF:
+                    return lo
+                if n == 0:
+                    return hi
+                frac = (rank - cum) / n
+                return lo + (hi - lo) * frac
+            cum += n
+            if hi != _INF:
+                lo = hi
+        return lo
+
+
+class MetricsRegistry:
+    """Name -> metric table. `counter`/`gauge`/`histogram` are
+    get-or-create (idempotent at module import sites); re-registering a
+    name as a different kind is an error."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help=help, **kwargs)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    "metric %r already registered as %s, requested %s"
+                    % (name, m.kind, cls.kind))
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self):
+        """Zero every metric's samples (registrations survive)."""
+        for m in self.metrics():
+            m.reset()
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self):
+        """[(name, kind, labels_dict, value)] — gauges/counters carry
+        their value, histograms a {count, sum} summary."""
+        rows = []
+        for m in self.metrics():
+            for key in sorted(m.labelsets()):
+                labels = dict(key)
+                if m.kind == "histogram":
+                    rows.append((m.name, m.kind, labels,
+                                 {"count": m.count(**labels),
+                                  "sum": m.sum(**labels)}))
+                else:
+                    rows.append((m.name, m.kind, labels, m.get(**labels)))
+        return rows
+
+    def to_prometheus(self):
+        """Prometheus text exposition format, ready for a scrape
+        endpoint or a textfile-collector drop."""
+        out = []
+        for m in self.metrics():
+            pname = _prom_name(m.name)
+            if m.kind == "counter":
+                pname += "_total"
+            if m.help:
+                out.append("# HELP %s %s" % (pname, m.help))
+            out.append("# TYPE %s %s" % (pname, m.kind))
+            for key in sorted(m.labelsets()):
+                labels = dict(key)
+                if m.kind == "histogram":
+                    with m._lock:
+                        cell = m._values.get(key)
+                        if cell is None:  # reset() raced the snapshot
+                            continue
+                        counts = list(cell["counts"])
+                        hsum, hcount = cell["sum"], cell["count"]
+                    cum = 0
+                    for i, bound in enumerate(m.buckets):
+                        cum += counts[i]
+                        le = "+Inf" if bound == _INF else repr(bound)
+                        lk = key + (("le", le),)
+                        out.append("%s_bucket%s %d"
+                                   % (pname, _prom_labels(lk), cum))
+                    out.append("%s_sum%s %g"
+                               % (pname, _prom_labels(key), hsum))
+                    out.append("%s_count%s %d"
+                               % (pname, _prom_labels(key), hcount))
+                else:
+                    out.append("%s%s %g" % (pname, _prom_labels(key),
+                                            m.get(**labels)))
+        return "\n".join(out) + ("\n" if out else "")
+
+    def to_jsonl(self):
+        """One JSON object per metric labelset (the machine-readable
+        twin of to_prometheus, same data)."""
+        lines = []
+        for name, kind, labels, value in self.snapshot():
+            rec = {"name": name, "type": kind, "labels": labels}
+            if kind == "histogram":
+                rec.update(value)
+            else:
+                rec["value"] = value
+            lines.append(json.dumps(rec, sort_keys=True))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: Process-wide default registry; module-level helpers bind to it.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name, help=""):
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name, help=""):
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):
+    return REGISTRY.histogram(name, help, buckets=buckets)

@@ -1,0 +1,72 @@
+"""Host-side span tracing with thread-local parent propagation.
+
+`span("name", **attrs)` is a context manager; nested spans record their
+parent's name, so the chrome trace reconstructs the call tree even
+across the duration-event flattening. Events feed the existing
+`profiler._record_event` stream, so host spans, eager-op dispatch rows,
+and the jax device trace all land in ONE timeline (open
+`<filename>.json` in chrome://tracing / Perfetto next to the device
+trace directory).
+
+Gating matches `profiler.record_op`: spans only record while the
+profiler is running. The disabled path is one dict lookup per
+`__enter__` — no allocation beyond the span object, no timestamps, no
+event append — so spans can stay in hot paths permanently
+(StepTimer.phase wraps its phases in spans for free).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..profiler import _record_event, _running
+
+__all__ = ["span", "current_span"]
+
+_tls = threading.local()
+
+
+def _stack():
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def current_span():
+    """Name of the innermost active span on this thread, or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class span:
+    """Context manager recording a host span into the profiler's
+    chrome-trace stream (cat="span"), with `parent` plus any keyword
+    attrs in the event's args."""
+
+    __slots__ = ("name", "attrs", "_t0", "_parent", "_active")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._active = _running["on"]
+        if self._active:
+            stack = _stack()
+            self._parent = stack[-1] if stack else None
+            stack.append(self.name)
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            t1 = time.perf_counter()
+            stack = _stack()
+            if stack and stack[-1] == self.name:
+                stack.pop()
+            args = {"parent": self._parent}
+            if self.attrs:
+                args.update(self.attrs)
+            _record_event(self.name, self._t0, t1, cat="span", args=args)
+        return False

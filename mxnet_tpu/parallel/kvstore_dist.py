@@ -18,17 +18,29 @@ sync SPMD is strictly the TPU-correct choice).
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError, getenv
 from ..kvstore import KVStore
+from ..observability import registry as _obs
 from ..resilience.chaos import chaos_point, InjectedFailure
 from ..resilience.retry import (RetryPolicy, TransientError, retry_call,
                                 run_with_deadline)
 
 __all__ = ["DistKVStore", "init_distributed"]
+
+# cross-process wire telemetry: bytes are this process's contribution
+# entering the collective (packed words for the compressed path, the
+# (indices, values) pair for row-sparse) — what actually rides ICI/DCN
+_AR_BYTES = _obs.counter("kvstore.allreduce.bytes",
+                         "Local bytes contributed to cross-process "
+                         "allreduce/allgather collectives")
+_AR_CALLS = _obs.counter("kvstore.allreduce.calls")
+_AR_SECONDS = _obs.histogram("kvstore.allreduce.seconds",
+                             "Wall time of one cross-process collective")
 
 
 _dist_initialized = False
@@ -168,6 +180,9 @@ class DistKVStore(KVStore):
         from jax.sharding import NamedSharding, PartitionSpec
         mesh = self._proc_mesh()
         x = jnp.asarray(x)
+        t0 = time.perf_counter()
+        _AR_BYTES.inc(int(x.size) * x.dtype.itemsize)
+        _AR_CALLS.inc()
         # global array (nproc, *x.shape) sharded over 'proc': this
         # process contributes x on its mesh device
         sharding = NamedSharding(mesh, PartitionSpec("proc"))
@@ -178,7 +193,9 @@ class DistKVStore(KVStore):
             (self._nproc,) + x.shape, sharding, arrays)
         out = self._reduce(global_x)
         # result is fully replicated; this process's view is the sum
-        return jnp.asarray(out.addressable_data(0))
+        result = jnp.asarray(out.addressable_data(0))
+        _AR_SECONDS.observe(time.perf_counter() - t0)
+        return result
 
     def _cross_process_sum_compressed(self, x, key):
         """Compressed allreduce: quantize the local contribution to 2-bit
@@ -190,8 +207,11 @@ class DistKVStore(KVStore):
         from jax.sharding import NamedSharding, PartitionSpec
         mesh = self._proc_mesh()
         x = jnp.asarray(x)
+        t0 = time.perf_counter()
         packed = self._compression.compress(key, x)
         self.last_wire_bytes = int(packed.size) * 4  # diagnostics/tests
+        _AR_BYTES.inc(self.last_wire_bytes)
+        _AR_CALLS.inc()
         sharding = NamedSharding(mesh, PartitionSpec("proc"))
         mine = [d for d in mesh.devices.flat
                 if d.process_index == jax.process_index()]
@@ -201,7 +221,9 @@ class DistKVStore(KVStore):
         thr = self._compression.threshold
         fn = self._dequant_sum_fn(x.shape, str(x.dtype), thr)
         out = fn(global_q)
-        return jnp.asarray(out.addressable_data(0))
+        result = jnp.asarray(out.addressable_data(0))
+        _AR_SECONDS.observe(time.perf_counter() - t0)
+        return result
 
     def _dequant_sum_fn(self, shape, dtype, thr):
         """Cached jitted all-gather+dequantize+sum per (shape, dtype)."""
@@ -243,7 +265,10 @@ class DistKVStore(KVStore):
             return idx, val
         from jax.sharding import NamedSharding, PartitionSpec
         mesh = self._proc_mesh()
+        t0 = time.perf_counter()
         self.last_wire_bytes = int(idx.size) * 4 + int(val.size) * 4
+        _AR_BYTES.inc(self.last_wire_bytes)
+        _AR_CALLS.inc()
         sharding_i = NamedSharding(mesh, PartitionSpec("proc"))
         mine = [d for d in mesh.devices.flat
                 if d.process_index == jax.process_index()][0]
@@ -264,8 +289,10 @@ class DistKVStore(KVStore):
                 out_shardings=(rep, rep))
             self._flatten_fn = flat
         oi, ov = flat(gi, gv)
-        return (jnp.asarray(oi.addressable_data(0)),
-                jnp.asarray(ov.addressable_data(0)))
+        result = (jnp.asarray(oi.addressable_data(0)),
+                  jnp.asarray(ov.addressable_data(0)))
+        _AR_SECONDS.observe(time.perf_counter() - t0)
+        return result
 
     def barrier(self):
         """Global barrier (reference: kvstore.py Barrier → ps-lite).

@@ -17,10 +17,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+
+from ..observability import registry as _obs
 
 __all__ = ["DevicePrefetcher"]
 
 _END = object()
+
+# same histogram io.DataIter.__next__ feeds: a blocking get() here is
+# the consumer stalled on input, wherever the wrapping happened
+_BATCH_WAIT = _obs.histogram("io.batch_wait.seconds",
+                             "Time the consumer blocked waiting for a batch")
 
 
 class DevicePrefetcher:
@@ -45,6 +53,8 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _worker(self):
+        from ..observability.telemetry import mark_producer_thread
+        mark_producer_thread()
         try:
             for item in self._source:
                 staged = self._stage(item)
@@ -66,7 +76,9 @@ class DevicePrefetcher:
     def __next__(self):
         if self._stop.is_set():
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
+        _BATCH_WAIT.observe(time.perf_counter() - t0)
         if item is _END:
             self._stop.set()
             raise StopIteration

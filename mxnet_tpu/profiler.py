@@ -20,6 +20,7 @@ import json
 import os
 import threading
 import time
+import weakref
 
 import jax
 
@@ -30,6 +31,9 @@ _aggregate = {}
 _events = []
 _lock = threading.Lock()
 _t_origin = time.perf_counter()
+# live Counter objects; weak so short-lived counters don't accumulate
+_counters = weakref.WeakSet()
+_counters_lock = threading.Lock()
 
 
 def set_config(**kwargs):
@@ -59,10 +63,12 @@ def _active():
     return _running["on"]
 
 
-def _record_event(name, t0, t1, cat="op"):
+def _record_event(name, t0, t1, cat="op", args=None):
     ev = {"name": name, "ph": "X", "cat": cat,
           "ts": (t0 - _t_origin) * 1e6, "dur": (t1 - t0) * 1e6,
           "pid": os.getpid(), "tid": threading.get_ident() & 0xffff}
+    if args:
+        ev["args"] = args
     with _lock:
         _events.append(ev)
         calls, total = _aggregate.get(name, (0, 0.0))
@@ -85,6 +91,7 @@ def dump(finished=True, profile_process="worker"):
         events = list(_events)
         if finished:
             _events.clear()
+    events += _counter_events(clear=finished)
     meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
              "args": {"name": "mxnet_tpu host"}}]
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -166,23 +173,66 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
+        if not _running["on"]:
+            return  # same gating as record_op: off == no events
         now = time.perf_counter()
         _record_event(self.name, now, now, cat="marker")
 
 
+def _counter_events(clear=False):
+    """Chrome-trace "C" events for every live Counter: each recorded
+    sample, plus the current value stamped at dump time (so a counter
+    that never changed while profiling still shows its level).
+    Reference gap closed: profiler.h's counters reach EmitEvents as
+    "C" rows; ours were write-only until now."""
+    now_ts = (time.perf_counter() - _t_origin) * 1e6
+    pid = os.getpid()
+    events = []
+    with _counters_lock:
+        live = list(_counters)
+    for c in live:
+        with c._lock:
+            samples = list(c._samples)
+            if clear:
+                c._samples.clear()
+            value = c.value
+        for ts, v in samples:
+            events.append({"name": c.name, "ph": "C", "cat": "counter",
+                           "ts": (ts - _t_origin) * 1e6, "pid": pid,
+                           "args": {"value": v}})
+        events.append({"name": c.name, "ph": "C", "cat": "counter",
+                       "ts": now_ts, "pid": pid, "args": {"value": value}})
+    return events
+
+
 class Counter:
+    """Named counter whose value lands in the chrome trace as "C"
+    (counter-track) events. Mutations are thread-safe; samples are only
+    retained while profiling is on (dump() always stamps the current
+    value, so an idle counter still appears)."""
+
     def __init__(self, domain=None, name="counter", value=0):
         self.name = name
         self.value = value
+        self._lock = threading.Lock()
+        self._samples = []
+        with _counters_lock:
+            _counters.add(self)
+
+    def _mutate(self, fn):
+        with self._lock:
+            self.value = fn(self.value)
+            if _running["on"]:
+                self._samples.append((time.perf_counter(), self.value))
 
     def set_value(self, value):
-        self.value = value
+        self._mutate(lambda _: value)
 
     def increment(self, delta=1):
-        self.value += delta
+        self._mutate(lambda v: v + delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self._mutate(lambda v: v - delta)
 
 
 class Domain:

@@ -1,30 +1,73 @@
-"""Process-wide resilience counters, surfaced for monitoring.
+"""Process-wide resilience counters — a shim over the observability
+registry.
 
 Incremented by the chaos injector (`chaos.injected.<site>`), the
 corrupt-record budget (`io.bad_records`), and retry loops
-(`retry.attempts.<what>`). Scrape with `counters` / `get`; tests call
-`reset_counters()` between cases.
+(`retry.attempts.<what>`). The `bump` / `get` / `reset_counters` /
+`counters` API is unchanged from PR 1, but the storage now lives in
+`observability.REGISTRY` as the labeled counter ``resilience.events``
+(label ``event=<name>``), so chaos injections, retries, and bad-record
+budgets show up in the same Prometheus/JSONL export as every other
+runtime metric (docs/observability.md).
 """
 from __future__ import annotations
 
-import collections
-import threading
+from ..observability.registry import counter as _counter
 
 __all__ = ["counters", "bump", "get", "reset_counters"]
 
-_lock = threading.Lock()
-counters = collections.defaultdict(int)
+_events = _counter("resilience.events",
+                   "Resilience events: chaos injections, retry attempts, "
+                   "skipped corrupt records")
 
 
 def bump(name, n=1):
-    with _lock:
-        counters[name] += n
+    _events.inc(n, event=name)
 
 
 def get(name):
-    return counters.get(name, 0)
+    return _events.get(event=name)
 
 
 def reset_counters():
-    with _lock:
-        counters.clear()
+    _events.reset()
+
+
+class _CountersView:
+    """Read-through mapping view preserving the old module-level
+    ``counters`` defaultdict surface (missing names read as 0)."""
+
+    def __getitem__(self, name):
+        return _events.get(event=name)
+
+    def get(self, name, default=0):
+        value = _events.get(event=name)
+        return value if value else default
+
+    def __contains__(self, name):
+        return _events.get(event=name) != 0
+
+    def _names(self):
+        return sorted(dict(key).get("event", "")
+                      for key in _events.labelsets())
+
+    def __iter__(self):
+        return iter(self._names())
+
+    def __len__(self):
+        return len(_events.labelsets())
+
+    def keys(self):
+        return self._names()
+
+    def items(self):
+        return [(n, _events.get(event=n)) for n in self._names()]
+
+    def clear(self):
+        _events.reset()
+
+    def __repr__(self):
+        return "resilience.counters(%r)" % dict(self.items())
+
+
+counters = _CountersView()

@@ -63,6 +63,26 @@ def main():
         except ImportError:
             print("%-12s : absent" % name)
 
+    print("----------Telemetry Counters----------")
+    # live snapshot of the process-wide registry (docs/observability.md):
+    # in a fresh diagnose process this shows what importing the
+    # framework alone recorded (e.g. warm-up XLA compiles); inside a
+    # training process it is the full runtime counter state
+    from mxnet_tpu.observability import REGISTRY, stream_path
+    print("MXTPU_TELEMETRY :", stream_path() or "(unset: step records off)")
+    rows = REGISTRY.snapshot()
+    if not rows:
+        print("(no metrics recorded)")
+    for name, kind, labels, value in rows:
+        tag = "{%s}" % ",".join("%s=%s" % kv
+                                for kv in sorted(labels.items())) \
+            if labels else ""
+        if kind == "histogram":
+            print("%-44s count=%d sum=%.4f"
+                  % (name + tag, value["count"], value["sum"]))
+        else:
+            print("%-44s %g" % (name + tag, value))
+
     print("----------Environment----------")
     for k, v in sorted(os.environ.items()):
         if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "DMLC_")):

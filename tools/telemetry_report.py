@@ -1,0 +1,144 @@
+"""Summarize an MXTPU_TELEMETRY JSONL step-record file.
+
+    python tools/telemetry_report.py /tmp/telemetry.jsonl
+    python tools/telemetry_report.py --json /tmp/telemetry.jsonl
+
+Reads the per-step records StepTimer streams (observability/telemetry.py)
+and prints p50/p95/p99 step time, samples/sec, data-wait and
+compile-stall totals, and bytes moved through the kvstore.
+
+Stdlib-only, and strict enough to gate CI on: exits non-zero when the
+file is missing, empty, or contains a malformed line — so a training
+gate can assert "telemetry stayed well-formed" with one command.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+class ReportError(Exception):
+    """Malformed/empty telemetry input (maps to exit code 1)."""
+
+
+def _percentile(sorted_values, q):
+    """Nearest-rank percentile of an already-sorted list, q in [0, 1]."""
+    if not sorted_values:
+        return 0.0
+    rank = max(1, -(-int(q * 1000) * len(sorted_values) // 1000))
+    rank = min(rank, len(sorted_values))
+    return sorted_values[rank - 1]
+
+
+def load_records(path):
+    """Parse one step record per line. Raises ReportError on unreadable
+    files, non-JSON lines, non-object lines, or records without a
+    numeric step_time (blank lines are tolerated: a line-buffered writer
+    killed mid-line leaves at most a partial LAST line, which is NOT
+    tolerated — a torn tail means the producer died mid-step)."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as err:
+        raise ReportError("cannot read %s: %s" % (path, err))
+    records = []
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as err:
+            raise ReportError("%s:%d: malformed JSON: %s"
+                              % (path, lineno, err))
+        if not isinstance(rec, dict):
+            raise ReportError("%s:%d: expected a JSON object, got %s"
+                              % (path, lineno, type(rec).__name__))
+        if not isinstance(rec.get("step_time"), (int, float)):
+            raise ReportError("%s:%d: record has no numeric step_time"
+                              % (path, lineno))
+        records.append(rec)
+    if not records:
+        raise ReportError("%s: no step records" % path)
+    return records
+
+
+def summarize(records):
+    step_times = sorted(float(r["step_time"]) for r in records)
+    total_time = sum(step_times)
+    total_samples = sum(int(r.get("batch_size", 0)) for r in records)
+    summary = {
+        "steps": len(records),
+        "sources": sorted({r.get("source", "?") for r in records}),
+        "total_time_s": total_time,
+        "step_time_p50_s": _percentile(step_times, 0.50),
+        "step_time_p95_s": _percentile(step_times, 0.95),
+        "step_time_p99_s": _percentile(step_times, 0.99),
+        "step_time_mean_s": total_time / len(records),
+        "data_wait_s": sum(float(r.get("data_wait", 0)) for r in records),
+        "compile_count": sum(int(r.get("compile_count", 0))
+                             for r in records),
+        "compile_stall_s": sum(float(r.get("compile_seconds", 0))
+                               for r in records),
+        "kvstore_bytes": sum(int(r.get("kvstore_bytes", 0))
+                             for r in records),
+    }
+    if total_samples and total_time > 0:
+        summary["samples"] = total_samples
+        summary["samples_per_sec"] = total_samples / total_time
+    return summary
+
+
+def _human_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return "%.1f %s" % (n, unit) if unit != "B" else "%d B" % n
+        n /= 1024.0
+    return "%d B" % n
+
+
+def format_summary(s):
+    lines = [
+        "telemetry summary (%d steps, sources: %s)"
+        % (s["steps"], ", ".join(s["sources"])),
+        "  step time   p50 %.4fs  p95 %.4fs  p99 %.4fs  mean %.4fs"
+        % (s["step_time_p50_s"], s["step_time_p95_s"],
+           s["step_time_p99_s"], s["step_time_mean_s"]),
+        "  total time  %.3fs" % s["total_time_s"],
+    ]
+    if "samples_per_sec" in s:
+        lines.append("  throughput  %.1f samples/sec (%d samples)"
+                     % (s["samples_per_sec"], s["samples"]))
+    pct = (100.0 * s["data_wait_s"] / s["total_time_s"]
+           if s["total_time_s"] > 0 else 0.0)
+    lines.append("  data wait   %.3fs (%.1f%% of step time)"
+                 % (s["data_wait_s"], pct))
+    lines.append("  compiles    %d (stall %.3fs)"
+                 % (s["compile_count"], s["compile_stall_s"]))
+    lines.append("  kvstore     %s moved"
+                 % _human_bytes(s["kvstore_bytes"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Summarize an MXTPU_TELEMETRY JSONL step-record file")
+    parser.add_argument("path", help="JSONL file written by StepTimer")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the summary as one JSON object")
+    args = parser.parse_args(argv)
+    try:
+        summary = summarize(load_records(args.path))
+    except ReportError as err:
+        print("telemetry_report: %s" % err, file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
