@@ -161,16 +161,30 @@ class Trainer:
     def _reduce(self):
         if not self._reduce_via_kv:
             return
-        for i, param in self._trainable():
-            self._kvstore.push(i, param.list_grad(), priority=-i)
-            if not self._update_via_kv:
-                self._kvstore.pull(i, param.list_grad(), priority=-i,
+        # one batched exchange for the whole gradient set: under
+        # `tpu_dist` this is the bucketed fused allreduce
+        # (parallel/bucketing.py) — a few large collectives issued in
+        # priority order (-i: earlier params first, what the next
+        # forward needs) instead of one per parameter
+        pairs = self._trainable()
+        if not pairs:
+            return
+        keys = [i for i, _ in pairs]
+        grads = [p.list_grad() for _, p in pairs]
+        prios = [-i for i in keys]
+        self._kvstore.push_all(keys, grads, priorities=prios)
+        if not self._update_via_kv:
+            self._kvstore.pull_all(keys, grads, priorities=prios,
                                    ignore_sparse=False)
 
     def _apply_updates(self):
         if self._update_via_kv:
-            for i, param in self._trainable():
-                self._kvstore.pull(i, param.list_data(), priority=-i)
+            pairs = self._trainable()
+            if pairs:
+                self._kvstore.pull_all(
+                    [i for i, _ in pairs],
+                    [p.list_data() for _, p in pairs],
+                    priorities=[-i for i, _ in pairs])
             return
         for updater in self._updaters:
             for i, param in self._trainable():

@@ -109,12 +109,24 @@ class GradientCompression:
 
     def compress(self, key, grad):
         """grad -> packed codes, updating the key's residual."""
-        res = self._residuals.get(key)
-        if res is None or res.shape != grad.shape:
-            res = jnp.zeros(grad.shape, grad.dtype)
+        res = self.residual(key, grad.shape, grad.dtype)
         packed, new_res = self._jq(grad, res, self.threshold)
         self._residuals[key] = new_res
         return packed
+
+    def residual(self, key, shape, dtype):
+        """Current error-feedback residual for `key` (zeros when absent
+        or when the key changed shape). The bucketed exchange
+        (parallel/kvstore_dist.py) reads residuals per key as bucket
+        slices and writes them back via `set_residual`, so residual
+        state survives bucket-membership changes intact."""
+        res = self._residuals.get(key)
+        if res is None or tuple(res.shape) != tuple(shape):
+            return jnp.zeros(shape, dtype)
+        return res
+
+    def set_residual(self, key, res):
+        self._residuals[key] = res
 
     def decompress(self, packed, shape, dtype=jnp.float32):
         return self._jd(packed, tuple(shape), self.threshold, dtype=dtype)

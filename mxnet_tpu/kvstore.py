@@ -82,21 +82,45 @@ def _push_retry_policy():
 
 
 def _sum_arrays(vals):
-    """Reduce a list of NDArrays (the CommDevice::Reduce analog — one fused
-    XLA add chain instead of the reference's copy+sum engine ops)."""
-    if len(vals) == 1:
-        return vals[0]._data
-    out = vals[0]._data
-    for v in vals[1:]:
-        out = out + v._data
-    return out
+    """Reduce a list of NDArrays (the CommDevice::Reduce analog — one
+    fused XLA reduction instead of the reference's copy+sum engine ops)."""
+    return _sum_jnp([v._data for v in vals])
 
 
 def _sum_jnp(arrays):
-    out = arrays[0]
+    """Sum same-rank addends: when shapes and dtypes agree (the common
+    multi-device merge), one stacked `jnp.sum` so XLA sees a single
+    fused reduction rather than an O(n) serial add chain; mismatched
+    inputs (broadcasting callers) keep the pairwise chain."""
+    if len(arrays) == 1:
+        return arrays[0]
+    first = arrays[0]
+    shape = getattr(first, "shape", None)
+    dtype = getattr(first, "dtype", None)
+    if all(getattr(a, "shape", None) == shape
+           and getattr(a, "dtype", None) == dtype for a in arrays[1:]):
+        return jnp.sum(jnp.stack(arrays), axis=0)
+    out = first
     for a in arrays[1:]:
         out = out + a
     return out
+
+
+def _priority_order(n, priorities):
+    """Issue order for a batched push/pull: stable descending priority.
+
+    Matches the reference engine's priority queues (src/kvstore/comm.h,
+    engine PushAsync priority): a HIGHER value is MORE urgent and issues
+    first; ties keep caller order. Callers pass ``priority=-i`` per
+    parameter slot, so earlier parameters — the ones the next forward
+    pass needs first — lead the exchange.
+    """
+    if priorities is None:
+        return list(range(n))
+    pr = list(priorities)
+    if len(pr) != n:
+        raise MXNetError("got %d priorities for %d keys" % (len(pr), n))
+    return sorted(range(n), key=lambda j: -pr[j])
 
 
 class KVStore:
@@ -150,11 +174,27 @@ class KVStore:
         return pol
 
     def push(self, key, value, priority=0):
+        """Push value(s) for key(s). `priority` follows the reference
+        semantics (higher = more urgent); it orders the issue of batched
+        exchanges — see `push_all`, which this delegates to."""
+        keys, values = _key_value(key, value)
+        self.push_all(keys, values, priorities=[priority] * len(keys))
+
+    def push_all(self, key, value, priorities=None):
+        """Batched push: one call covering many keys.
+
+        Keys issue in stable descending-priority order (the reference's
+        comm.h priority queues; see `_priority_order`). The base store
+        pushes per key; `DistKVStore` overrides this with the bucketed
+        fused exchange (parallel/bucketing.py) so a whole step's
+        gradients ride a few large collectives.
+        """
         keys, values = _key_value(key, value)
         policy = self._push_policy()
         t0 = time.perf_counter()
         nbytes = 0
-        for k, v in zip(keys, values):
+        for j in _priority_order(len(keys), priorities):
+            k, v = keys[j], values[j]
             if k not in self._data:
                 raise MXNetError("key %r not initialized" % (k,))
             nbytes += _nbytes(v)
@@ -184,6 +224,12 @@ class KVStore:
         else:
             merged = _sum_arrays(list(vals))
         merged = self._after_merge(merged, k)
+        self._apply_merged(k, merged)
+
+    def _apply_merged(self, k, merged):
+        """Land an already-reduced value: run the updater, or store it
+        (reference kvstore_local PushImpl copies the reduce result).
+        Shared by the per-key path and the bucketed unpack."""
         tgt = self._data[k]._data
         if getattr(merged, "sharding", None) != getattr(tgt, "sharding",
                                                         None):
@@ -191,8 +237,6 @@ class KVStore:
         if self._updater is not None:
             self._updater(_updater_key(k), NDArray(merged), self._data[k])
         else:
-            # no updater: store the merged value (reference
-            # kvstore_local PushImpl copies the reduce result)
             self._data[k]._data = merged
 
     def _push_row_sparse(self, k, vals):
@@ -230,20 +274,35 @@ class KVStore:
         return idx, val
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull value(s) for key(s); `priority` orders batched pulls
+        (see `pull_all`)."""
+        keys, outs = _key_value(key, out)
+        self.pull_all(keys, outs, priorities=[priority] * len(keys),
+                      ignore_sparse=ignore_sparse)
+
+    def pull_all(self, key, out=None, priorities=None, ignore_sparse=True):
+        """Batched pull mirroring `push_all`: keys issue in stable
+        descending-priority order so the parameters the next forward
+        needs first are materialized first."""
         keys, outs = _key_value(key, out)
         t0 = time.perf_counter()
         nbytes = 0
-        for k, o in zip(keys, outs):
-            if k not in self._data:
-                raise MXNetError("key %r not initialized" % (k,))
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            src = self._data[k]._data
-            nbytes += int(src.size) * src.dtype.itemsize * len(targets)
-            for t in targets:
-                t._data = src
+        for j in _priority_order(len(keys), priorities):
+            nbytes += self._pull_one(keys[j], outs[j])
         _PULL_BYTES.inc(nbytes)
         _PULL_CALLS.inc()
         _PULL_SECONDS.observe(time.perf_counter() - t0)
+
+    def _pull_one(self, k, o):
+        """Copy one key's stored value into its target(s); returns the
+        bytes moved."""
+        if k not in self._data:
+            raise MXNetError("key %r not initialized" % (k,))
+        targets = o if isinstance(o, (list, tuple)) else [o]
+        src = self._data[k]._data
+        for t in targets:
+            t._data = src
+        return int(src.size) * src.dtype.itemsize * len(targets)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference: kvstore.py:312,
@@ -298,6 +357,11 @@ class KVStore:
         from .gradient_compression import GradientCompression
         self._compression = GradientCompression.from_params(
             self._compress_params)
+
+    def set_bucket_size_mb(self, mb):
+        """Retarget the gradient fusion-bucket size (MXTPU_BUCKET_MB
+        override; 0 disables bucketing). A no-op here: only the
+        cross-process store buckets its exchange (DistKVStore)."""
 
     # -- persistence ----------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -55,21 +55,35 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
-    """Push grads, pull updated weights (reference: model.py:145)."""
+    """Push grads, pull updated weights (reference: model.py:145).
+
+    The whole parameter set goes through one batched push_all/pull_all
+    pair so a dist kvstore can fuse the gradients into buckets and
+    issue one collective per bucket (parallel/bucketing.py) instead of
+    one per parameter."""
+    names, args, grads, prios = [], [], [], []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list is None or (isinstance(grad_list, list)
                                  and grad_list[0] is None):
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        names.append(param_names[index])
+        args.append(arg_list)
+        grads.append(grad_list)
+        prios.append(-index)
+    if not names:
+        return
+    kvstore.push_all(names, grads, priorities=prios)
+    kvstore.pull_all(names, args, priorities=prios)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
-    """Local updater path (reference: model.py:157)."""
+    """Local updater path (reference: model.py:157). The optional
+    kvstore reduce batches the whole gradient set like
+    `_update_params_on_kvstore` does."""
     updates = [[] for _ in range(num_device)]
+    names, kv_grads, prios = [], [], []
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if not isinstance(arg_list, (list, tuple)):
@@ -78,12 +92,15 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             continue
         index = i
         if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
+            names.append(param_names[index])
+            kv_grads.append(grad_list)
+            prios.append(-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updates[k].append((index * num_device + k, g, w))
+    if kvstore and names:
+        kvstore.push_all(names, kv_grads, priorities=prios)
+        kvstore.pull_all(names, kv_grads, priorities=prios)
     for dev_updates in updates:
         for upd in dev_updates:
             i, g, w = upd

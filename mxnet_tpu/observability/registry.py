@@ -45,10 +45,19 @@ def _prom_name(name):
     return san if san.startswith("mxtpu_") else "mxtpu_" + san
 
 
+def _prom_label_value(v):
+    """Prometheus exposition escaping: backslash, quote, newline. An
+    unescaped user-supplied label (e.g. a symbol name feeding
+    cachedop.jit.builds{op=...}) would otherwise corrupt the whole
+    scrape payload."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _prom_labels(key):
     if not key:
         return ""
-    return "{%s}" % ",".join('%s="%s"' % (k, v) for k, v in key)
+    return "{%s}" % ",".join('%s="%s"' % (k, _prom_label_value(v))
+                             for k, v in key)
 
 
 class _Metric:

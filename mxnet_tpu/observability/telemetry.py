@@ -25,7 +25,7 @@ import threading
 import time
 import warnings
 
-from .registry import counter, gauge, histogram
+from .registry import REGISTRY, counter, gauge, histogram
 from .span import span
 
 __all__ = ["StepTimer", "stream_path", "stream_enabled", "emit",
@@ -160,12 +160,40 @@ def close_stream():
 
 
 # -- StepTimer ----------------------------------------------------------
+def _counter_total(name):
+    """Total of a registry counter that may not be registered yet (the
+    kvstore.bucket.* family registers on first dist-kvstore import, with
+    its own bucket bounds — looked up by name so this module never
+    races that registration)."""
+    m = REGISTRY.get(name)
+    return m.total() if m is not None and hasattr(m, "total") else 0
+
+
+def _hist_totals(name):
+    """(sum, count) of a maybe-unregistered registry histogram."""
+    m = REGISTRY.get(name)
+    if m is None or not hasattr(m, "total_sum"):
+        return 0.0, 0
+    return m.total_sum(), m.total_count()
+
+
 def _counters_snapshot():
+    fill_sum, _ = _hist_totals("kvstore.bucket.fill_ratio")
+    pack_s, _ = _hist_totals("kvstore.bucket.pack.seconds")
+    unpack_s, _ = _hist_totals("kvstore.bucket.unpack.seconds")
+    ar_s, _ = _hist_totals("kvstore.allreduce.seconds")
     return {
         "compile_count": COMPILE_COUNT.total(),
         "compile_seconds": COMPILE_SECONDS.total(),
         "kvstore_bytes": sum(c.total() for c in _KV_BYTE_COUNTERS),
         "data_wait": _BATCH_WAIT.total_sum(),
+        "allreduce_calls": _counter_total("kvstore.allreduce.calls"),
+        "allreduce_bytes": _counter_total("kvstore.allreduce.bytes"),
+        "allreduce_seconds": ar_s,
+        "bucket_count": _counter_total("kvstore.bucket.count"),
+        "bucket_fill_sum": fill_sum,
+        "bucket_pack_seconds": pack_s,
+        "bucket_unpack_seconds": unpack_s,
     }
 
 
@@ -260,6 +288,16 @@ class StepTimer:
                 0.0, snap["compile_seconds"] - prev["compile_seconds"]),
             "kvstore_bytes": snap["kvstore_bytes"] - prev["kvstore_bytes"],
         }
+        # allreduce/bucket deltas (tools/telemetry_report.py's
+        # allreduce section); zero-valued fields are omitted so
+        # single-process step records stay the size they were
+        for field in ("allreduce_calls", "allreduce_bytes",
+                      "allreduce_seconds", "bucket_count",
+                      "bucket_fill_sum", "bucket_pack_seconds",
+                      "bucket_unpack_seconds"):
+            delta = snap[field] - prev.get(field, 0)
+            if delta:
+                record[field] = delta
         for name, secs in self._phases.items():
             record[name + "_time"] = secs
         self._phases = {}

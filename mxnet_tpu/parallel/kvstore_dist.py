@@ -24,11 +24,15 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError, getenv
-from ..kvstore import KVStore
+from ..kvstore import (KVStore, _key_value, _nbytes, _priority_order,
+                       _sum_arrays, _PUSH_BYTES, _PUSH_CALLS,
+                       _PUSH_SECONDS)
 from ..observability import registry as _obs
 from ..resilience.chaos import chaos_point, InjectedFailure
 from ..resilience.retry import (RetryPolicy, TransientError, retry_call,
                                 run_with_deadline)
+from .bucketing import (GradBucketer, BUCKET_COUNT, BUCKET_KEYS,
+                        BUCKET_FILL, PACK_SECONDS, UNPACK_SECONDS)
 
 __all__ = ["DistKVStore", "init_distributed"]
 
@@ -44,6 +48,27 @@ _AR_SECONDS = _obs.histogram("kvstore.allreduce.seconds",
 
 
 _dist_initialized = False
+
+
+def _enable_cpu_collectives():
+    """Multi-process runs on the CPU backend need a real collectives
+    implementation — without it every cross-process reduce dies with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Select gloo (jax >= 0.4.x ships it) BEFORE the backend client is
+    created; TPU/GPU platforms are untouched. Best-effort: an older
+    jax without the flag, or one whose backends already exist, just
+    keeps its current behavior."""
+    try:
+        platforms = jax.config.jax_platforms or os.environ.get(
+            "JAX_PLATFORMS", "")
+    except AttributeError:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in platforms:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 class _AlreadyInitialized(MXNetError):
@@ -88,6 +113,7 @@ def init_distributed(coordinator_address=None, num_processes=None,
     timeout = getenv("MXTPU_DIST_INIT_TIMEOUT_S", 0.0)
     if timeout > 0:
         kwargs["initialization_timeout"] = int(timeout)
+    _enable_cpu_collectives()
 
     def _attempt():
         chaos_point("dist.init")
@@ -125,6 +151,15 @@ class DistKVStore(KVStore):
         self._nproc = jax.process_count()
         self._mesh = None
         self._reduce = None
+        self._bucketer = GradBucketer()  # MXTPU_BUCKET_MB
+
+    def set_bucket_size_mb(self, mb):
+        """Retarget the fusion-bucket size for the bucketed exchange
+        (overrides MXTPU_BUCKET_MB for this store; 0 falls back to the
+        per-key path). Drops cached plans — per-bucket state keyed by
+        bucket signature (compression residuals) restarts from zero,
+        the same rule a membership change applies."""
+        self._bucketer = GradBucketer(int(float(mb) * (1 << 20)))
 
     # -- identity -------------------------------------------------------
     @property
@@ -151,6 +186,133 @@ class DistKVStore(KVStore):
             # training semantics don't depend on the process count
             merged = self._compression.roundtrip(key, merged)
         return merged
+
+    # -- bucketed exchange ---------------------------------------------
+    # push_all fuses the whole batch of gradients into a few flat
+    # buckets (parallel/bucketing.py) and runs ONE collective per bucket
+    # instead of one per key — the ps-lite message-batching analog. JAX
+    # dispatch is asynchronous, so the collective for the first
+    # (highest-priority) buckets runs while the host is still packing
+    # later ones: exchange overlaps pack/update work.
+    def push_all(self, key, value, priorities=None):
+        keys, values = _key_value(key, value)
+        if self._nproc <= 1 or self._bucketer.target_bytes <= 0 \
+                or len(set(keys)) != len(keys):
+            # repeated keys must merge sequentially (per-key semantics);
+            # the fused pack would silently collapse them
+            return super().push_all(keys, values, priorities=priorities)
+        from ..ndarray.sparse import RowSparseNDArray
+        order = _priority_order(len(keys), priorities)
+        prios = list(priorities) if priorities is not None \
+            else [0] * len(keys)
+        # row-sparse keys keep the per-key wire format but still honor
+        # priority at the dense boundary: sparse keys more urgent than
+        # every dense key (e.g. an embedding at slot 0) issue BEFORE
+        # the dense buckets, the rest after
+        dense, sparse_hi, sparse_lo = [], [], []
+        for j in order:
+            if keys[j] not in self._data:
+                raise MXNetError("key %r not initialized" % (keys[j],))
+            vals = values[j] if isinstance(values[j], (list, tuple)) \
+                else [values[j]]
+            if all(isinstance(a, RowSparseNDArray) for a in vals):
+                (sparse_lo if dense else sparse_hi).append(j)
+            else:
+                dense.append(j)
+        t0 = time.perf_counter()
+        nbytes = sum(_nbytes(values[j]) for j in order)
+        policy = self._push_policy()
+        for j in sparse_hi:
+            retry_call(self._push_one, keys[j], values[j], policy=policy)
+        if dense:
+            self._push_bucketed([keys[j] for j in dense],
+                                [values[j] for j in dense],
+                                [prios[j] for j in dense])
+        for j in sparse_lo:
+            retry_call(self._push_one, keys[j], values[j], policy=policy)
+        _PUSH_BYTES.inc(nbytes)
+        _PUSH_CALLS.inc()
+        _PUSH_SECONDS.observe(time.perf_counter() - t0)
+
+    def _push_bucketed(self, keys, values, priorities):
+        """Fused dense exchange: local device merge per key, pack into
+        dtype-homogeneous buckets, one cross-process collective per
+        bucket, then unpack + update. Bit-identical to the per-key path
+        (same elementwise additions, same cross-process order)."""
+        comp = self._compression
+        merged, items = {}, []
+        for k, v, pr in zip(keys, values, priorities):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            m = jnp.asarray(_sum_arrays(list(vals)))
+            merged[k] = m
+            # compression-active keys ride separate buckets (lane) so
+            # bypassed small keys keep the uncompressed wire format,
+            # exactly as the per-key path decides via active_for()
+            lane = bool(comp is not None and comp.active_for(m))
+            items.append((k, tuple(m.shape), str(m.dtype), int(pr), lane))
+        policy = self._push_policy()
+        issued = []
+        for bucket in self._bucketer.plan(items):
+            out = retry_call(self._issue_bucket, bucket, merged,
+                             policy=policy)
+            issued.append((bucket, out))
+        for bucket, out in issued:
+            t0 = time.perf_counter()
+            for k, sub in zip(bucket.keys, bucket.unpack(out)):
+                self._apply_merged(k, sub)
+            UNPACK_SECONDS.observe(time.perf_counter() - t0)
+
+    def _issue_bucket(self, bucket, merged):
+        """Pack one bucket and dispatch its collective (the retry unit:
+        `chaos_point` precedes every mutation, including the compression
+        residual update, so a replay recomputes from unchanged state)."""
+        chaos_point("kvstore.push")
+        t0 = time.perf_counter()
+        flat = bucket.pack([merged[k] for k in bucket.keys])
+        PACK_SECONDS.observe(time.perf_counter() - t0)
+        BUCKET_COUNT.inc()
+        BUCKET_KEYS.inc(len(bucket.keys))
+        BUCKET_FILL.observe(bucket.nbytes /
+                            max(1, self._bucketer.target_bytes))
+        if bucket.lane:
+            return self._bucket_sum_compressed(flat, bucket)
+        return self._cross_process_sum(flat)
+
+    def _bucket_sum_compressed(self, flat, bucket):
+        """Compressed bucket collective. Residuals stay PER KEY (read
+        as slices, written back as slices), so the error-feedback state
+        survives bucket-layout changes by construction — a membership
+        change just re-slices the same per-key residuals into the new
+        buckets (the PR-2 elastic-resume invariant). Elementwise the
+        math is identical to the per-key compressed path; only the
+        packed-word framing differs."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        comp = self._compression
+        mesh = self._proc_mesh()
+        t0 = time.perf_counter()
+        res = [comp.residual(k, shp, flat.dtype)
+               for k, shp in zip(bucket.keys, bucket.shapes)]
+        res_flat = jnp.ravel(res[0]) if len(res) == 1 \
+            else jnp.concatenate([jnp.ravel(r) for r in res])
+        packed, new_res = comp._jq(flat, res_flat, comp.threshold)
+        for k, off, size, shp in zip(bucket.keys, bucket.offsets,
+                                     bucket.sizes, bucket.shapes):
+            comp.set_residual(k, new_res[off:off + size].reshape(shp))
+        self.last_wire_bytes = int(packed.size) * 4
+        _AR_BYTES.inc(self.last_wire_bytes)
+        _AR_CALLS.inc()
+        sharding = NamedSharding(mesh, PartitionSpec("proc"))
+        mine = [d for d in mesh.devices.flat
+                if d.process_index == jax.process_index()]
+        arrays = [jax.device_put(packed[None], d) for d in mine]
+        global_q = jax.make_array_from_single_device_arrays(
+            (self._nproc,) + packed.shape, sharding, arrays)
+        fn = self._dequant_sum_fn((int(flat.size),), str(flat.dtype),
+                                  comp.threshold)
+        out = fn(global_q)
+        result = jnp.asarray(out.addressable_data(0))
+        _AR_SECONDS.observe(time.perf_counter() - t0)
+        return result
 
     def _proc_mesh(self):
         """1-D 'proc' mesh: one device per process (works for any

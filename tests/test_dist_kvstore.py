@@ -45,3 +45,7 @@ def test_dist_sync_kvstore_four_processes():
     for r, rc, out in outs:
         assert rc == 0, "worker %d failed (rc=%d):\n%s" % (r, rc, out[-3000:])
         assert ("WORKER_%d_OK" % r) in out
+        # bucketed exchange bit-identical to per-key, compression on/off
+        # (asserted inside the worker; the markers prove it ran)
+        assert ("BUCKET_PARITY_OK_%d" % r) in out
+        assert ("COMPRESSED_BUCKET_PARITY_OK_%d" % r) in out

@@ -87,6 +87,30 @@ def summarize(records):
     if total_samples and total_time > 0:
         summary["samples"] = total_samples
         summary["samples_per_sec"] = total_samples / total_time
+    # allreduce/bucket section (dist runs; fields absent on
+    # single-process records)
+    ar_calls = sum(int(r.get("allreduce_calls", 0)) for r in records)
+    bucket_count = sum(int(r.get("bucket_count", 0)) for r in records)
+    if ar_calls or bucket_count:
+        # percentile over steps that actually exchanged — records
+        # without the field (eval/idle/single-process steps) would
+        # dilute the p95 toward zero and mask a regressed collective
+        ar_seconds = sorted(float(r["allreduce_seconds"]) for r in records
+                            if "allreduce_seconds" in r)
+        fill_sum = sum(float(r.get("bucket_fill_sum", 0.0))
+                       for r in records)
+        summary["allreduce_calls"] = ar_calls
+        summary["allreduce_bytes"] = sum(
+            int(r.get("allreduce_bytes", 0)) for r in records)
+        summary["allreduce_s"] = sum(ar_seconds)
+        summary["allreduce_p95_s"] = _percentile(ar_seconds, 0.95)
+        summary["bucket_count"] = bucket_count
+        if bucket_count:
+            summary["bucket_fill_mean"] = fill_sum / bucket_count
+        summary["bucket_pack_s"] = sum(
+            float(r.get("bucket_pack_seconds", 0.0)) for r in records)
+        summary["bucket_unpack_s"] = sum(
+            float(r.get("bucket_unpack_seconds", 0.0)) for r in records)
     return summary
 
 
@@ -118,6 +142,18 @@ def format_summary(s):
                  % (s["compile_count"], s["compile_stall_s"]))
     lines.append("  kvstore     %s moved"
                  % _human_bytes(s["kvstore_bytes"]))
+    if "allreduce_calls" in s:
+        lines.append(
+            "  allreduce   %d calls  %s on the wire  total %.3fs  "
+            "p95/step %.4fs"
+            % (s["allreduce_calls"], _human_bytes(s["allreduce_bytes"]),
+               s["allreduce_s"], s["allreduce_p95_s"]))
+        if s.get("bucket_count"):
+            lines.append(
+                "  buckets     %d issued  fill %.0f%%  pack %.3fs  "
+                "unpack %.3fs"
+                % (s["bucket_count"], 100.0 * s.get("bucket_fill_mean", 0),
+                   s["bucket_pack_s"], s["bucket_unpack_s"]))
     return "\n".join(lines)
 
 
