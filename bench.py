@@ -22,6 +22,7 @@ extra.ladder recording each rung's img/s or failure status.
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -52,7 +53,7 @@ def _apply_platform_override():
         jax.config.update("jax_platforms", plat)
 
 
-def _probe_devices(timeout_s=180, parent_init=True):
+def _probe_devices(timeout_s=180, parent_init=True, retries=None):
     """Probe + recovery (the recorded metric must be a real measurement
     or a clean error, never a hang — and round 3 proved one failed
     probe shouldn't be the end: recover, then retry).
@@ -65,10 +66,11 @@ def _probe_devices(timeout_s=180, parent_init=True):
     wedges clear with time, not force.
     """
     import subprocess
-    import sys
     # 6 probes spanning ~35 min by default: relay-lease wedges clear
     # with time (round 4 evidence), so a short probe burst undersamples
-    retries = int(os.environ.get("MXTPU_BENCH_PROBE_RETRIES", 6))
+    # (callers with a CPU fallback pass a smaller retries)
+    if retries is None:
+        retries = int(os.environ.get("MXTPU_BENCH_PROBE_RETRIES", 6))
     waits = (60, 120, 240, 480, 600, 600)
     plat = os.environ.get("MXTPU_BENCH_PLATFORM")
     pin = ("import jax; jax.config.update('jax_platforms', %r); " % plat
@@ -76,6 +78,7 @@ def _probe_devices(timeout_s=180, parent_init=True):
     code = (pin + "from mxnet_tpu.base import probe_devices; import sys; "
             "d, e = probe_devices(%d); "
             "sys.stderr.write('' if d else str(e)); "
+            "d and sys.stdout.write(d[0].platform); "
             "sys.exit(0 if d else 1)" % timeout_s)
     err = "?"
     here = os.path.dirname(os.path.abspath(__file__))
@@ -91,12 +94,15 @@ def _probe_devices(timeout_s=180, parent_init=True):
             err = "probe child wedged past %ds" % (timeout_s + 60)
         else:
             if r.returncode == 0:
+                # the child reports its backend platform on stdout so
+                # the caller can notice a TPU-less (cpu-only) host
+                plat = (r.stdout or "").strip() or "unknown"
                 if not parent_init:
                     # ladder mode: measurement runs in child processes,
                     # and a parent that inits PJRT would HOLD the device
                     # lease for the whole ladder, blocking every rung
                     # child's init (kill_stale.py's holder model)
-                    return True
+                    return plat
                 # do the PARENT's backend init under the same deadline:
                 # this process hasn't attempted init yet, so the probe
                 # both guards and performs it (a wedge in the window
@@ -105,7 +111,7 @@ def _probe_devices(timeout_s=180, parent_init=True):
                 from mxnet_tpu.base import probe_devices
                 devs, perr = probe_devices(timeout_s)
                 if devs is not None:
-                    return True
+                    return plat
                 raise SystemExit(
                     "bench: probe child ok but parent init failed (%s)"
                     % perr)
@@ -465,13 +471,69 @@ def _enable_compile_cache():
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
 
 
+def _fallback_to_cpu():
+    """TPU-less (or wedged-tunnel) host: retarget the measurement at
+    the CPU backend instead of dying with a traceback — the perf
+    record must exist and parse on every host, and its `platform`
+    field says what was actually measured. The workload shrinks to
+    CPU-feasible sizes unless the caller pinned its own; a ResNet-50
+    b128 50-step scan on CPU would blow every rung deadline."""
+    global BATCH, IMG, STEPS, UNROLL
+    # drop a wedged accelerator pin for this process and the rung
+    # children, then pin the retry explicitly (the ISSUE's
+    # JAX_PLATFORMS='' retry, made deterministic)
+    os.environ["JAX_PLATFORMS"] = ""
+    os.environ["MXTPU_BENCH_PLATFORM"] = "cpu"
+    # the CI-smoke sizes (tests/test_bench_smoke.py): measured to fit a
+    # rung deadline on CPU — 224px resnet50 does NOT, at any batch size
+    for var, small in (("MXTPU_BENCH_BATCH", "8"),
+                       ("MXTPU_BENCH_IMG", "32"),
+                       ("MXTPU_BENCH_STEPS", "2"),
+                       ("MXTPU_BENCH_UNROLL", "1"),
+                       ("MXTPU_BENCH_SCORE", "0"),
+                       ("MXTPU_BENCH_EXTRAS", "0")):
+        os.environ.setdefault(var, small)
+    BATCH = int(os.environ["MXTPU_BENCH_BATCH"])
+    IMG = int(os.environ["MXTPU_BENCH_IMG"])
+    STEPS = int(os.environ["MXTPU_BENCH_STEPS"])
+    UNROLL = int(os.environ["MXTPU_BENCH_UNROLL"])
+    _apply_platform_override()
+
+
 def main():
     _enable_compile_cache()
     if os.environ.get("MXTPU_BENCH_CHILD"):
         return _measure_main()
     _apply_platform_override()
     ladder_mode = _flag("MXTPU_BENCH_LADDER")
-    _probe_devices(parent_init=not ladder_mode)
+    # with the CPU fallback armed, cut the probe burst short: two
+    # wedged 180s probes are evidence enough when a working backend
+    # is one env var away (an explicit platform pin disarms it)
+    fallback_ok = _flag("MXTPU_BENCH_CPU_FALLBACK") and \
+        not os.environ.get("MXTPU_BENCH_PLATFORM")
+    # an explicit probe budget wins over the fallback's short burst:
+    # on hosts whose relay wedges clear after N probes, giving up at 2
+    # would record a misleading CPU number when the chip was reachable
+    short_burst = 2 if fallback_ok and \
+        "MXTPU_BENCH_PROBE_RETRIES" not in os.environ else None
+    try:
+        plat = _probe_devices(parent_init=not ladder_mode,
+                              retries=short_burst)
+    except SystemExit as err:
+        if not fallback_ok:
+            raise
+        sys.stderr.write("bench: %s; falling back to the CPU backend\n"
+                         % err)
+        _fallback_to_cpu()
+        _probe_devices(parent_init=not ladder_mode)
+    else:
+        if plat == "cpu" and fallback_ok:
+            # the backend came up but there is no accelerator: the
+            # default-size ladder would blow every rung deadline on
+            # CPU — shrink so a TPU-less host still records a number
+            sys.stderr.write("bench: cpu-only backend; shrinking to "
+                             "CPU-feasible sizes\n")
+            _fallback_to_cpu()
     if not ladder_mode:
         return _measure_main()
     best, extra, ladder = None, {}, {}
@@ -556,6 +618,9 @@ def _measure_main():
         "metric": "resnet50_v1_train_throughput_b%d" % BATCH,
         "value": round(img_s, 2), "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        # what the number was measured on: a CPU-fallback record must
+        # never be mistaken for a chip measurement
+        "platform": jax.default_backend(),
         "extra": extra}))
 
 

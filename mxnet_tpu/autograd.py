@@ -231,13 +231,18 @@ def _walk(heads, head_grads, retain_graph, collect_for=None):
         if not retain_graph:
             node.vjp_fn = None
 
-    # write into .grad buffers
+    # write into .grad buffers; the freshness mark backs
+    # Trainer.step(ignore_stale_grad=True) — only a backward pass makes
+    # a grad "fresh" (the reference's _fresh_grad contract;
+    # zero_grad/manual writes do not)
     for _, (nd, g) in leaf_grads.items():
         if nd._grad is not None:
             if nd._grad_req == "add":
                 nd._grad._data = nd._grad._data + g
+                nd._grad._fresh_grad = True
             elif nd._grad_req != "null":
                 nd._grad._data = g
+                nd._grad._fresh_grad = True
 
     if collect_for is not None:
         out = []

@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .graph import build_graph_fn, collect_vars
 from .ndarray import NDArray
 from .observability import registry as _obs
@@ -79,7 +79,15 @@ class CachedOp:
                 _, vjp_fn = jax.vjp(f, args)
                 return vjp_fn(list(cots))[0]
 
-            self._bwd_jits[mode] = jax.jit(bwd)
+            # MXTPU_DONATE_CACHEDOP=1: donate the output cotangents —
+            # the one backward input that is step-local (weights/aux
+            # must outlive the call). Opt-in: a cotangent can alias a
+            # user-visible .grad buffer when an intermediate output has
+            # attach_grad, and donation would invalidate it
+            # (docs/performance.md "donation caveats").
+            donate = (3,) if getenv("MXTPU_DONATE_CACHEDOP", False) \
+                else ()
+            self._bwd_jits[mode] = jax.jit(bwd, donate_argnums=donate)
         return self._bwd_jits[mode]
 
     def __call__(self, *inputs):

@@ -136,7 +136,7 @@ class Trainer:
         with tel.phase("allreduce"):
             self._reduce()
         with tel.phase("optimizer"):
-            self._apply_updates()
+            self._apply_updates(ignore_stale_grad)
         tel.end_step(batch_size=batch_size)
 
     def allreduce_grads(self):
@@ -156,7 +156,7 @@ class Trainer:
             "update() when parameters are updated on kvstore is not " \
             "supported."
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._apply_updates()
+        self._apply_updates(ignore_stale_grad)
 
     def _reduce(self):
         if not self._reduce_via_kv:
@@ -177,7 +177,7 @@ class Trainer:
             self._kvstore.pull_all(keys, grads, priorities=prios,
                                    ignore_sparse=False)
 
-    def _apply_updates(self):
+    def _apply_updates(self, ignore_stale_grad=False):
         if self._update_via_kv:
             pairs = self._trainable()
             if pairs:
@@ -186,9 +186,25 @@ class Trainer:
                     [p.list_data() for _, p in pairs],
                     priorities=[-i for i, _ in pairs])
             return
+        pairs = self._trainable()
+        if ignore_stale_grad:
+            # the reference's _fresh_grad contract: only params whose
+            # grad a backward pass wrote since the last update
+            # participate (autograd sets the mark, the update consumes
+            # it; zero_grad/manual writes don't refresh)
+            pairs = [(i, p) for i, p in pairs if p.grad()._fresh_grad]
+        if not pairs:
+            return
+        # ONE batched call over the whole trainable set: FusedUpdater
+        # groups it into a handful of donated jit updates instead of
+        # one dispatch per parameter (parallel/fused_update.py)
+        idxs = [i for i, _ in pairs]
+        grads = [p.grad() for _, p in pairs]
+        weights = [p.data() for _, p in pairs]
         for updater in self._updaters:
-            for i, param in self._trainable():
-                updater(i, param.grad(), param.data())
+            updater.update_all(idxs, grads, weights)
+        for g in grads:
+            g._fresh_grad = False
 
     # -- state io -------------------------------------------------------
     def save_states(self, fname):

@@ -136,6 +136,9 @@ class KVStore:
         self._optimizer = None
         self._compress_params = {"type": "none"}
         self._compression = None  # GradientCompression when active
+        # batched-update scope: while a push_all is collecting, merged
+        # dense values land here instead of running the updater per key
+        self._pending_updates = None
 
     # -- identity -------------------------------------------------------
     @property
@@ -193,15 +196,49 @@ class KVStore:
         policy = self._push_policy()
         t0 = time.perf_counter()
         nbytes = 0
-        for j in _priority_order(len(keys), priorities):
-            k, v = keys[j], values[j]
-            if k not in self._data:
-                raise MXNetError("key %r not initialized" % (k,))
-            nbytes += _nbytes(v)
-            retry_call(self._push_one, k, v, policy=policy)
+        batch = self._begin_update_batch(keys)
+        try:
+            for j in _priority_order(len(keys), priorities):
+                k, v = keys[j], values[j]
+                if k not in self._data:
+                    raise MXNetError("key %r not initialized" % (k,))
+                nbytes += _nbytes(v)
+                retry_call(self._push_one, k, v, policy=policy)
+        finally:
+            self._flush_update_batch(batch)
         _PUSH_BYTES.inc(nbytes)
         _PUSH_CALLS.inc()
         _PUSH_SECONDS.observe(time.perf_counter() - t0)
+
+    def _begin_update_batch(self, keys):
+        """Open a batched-update scope: dense merges from `_apply_merged`
+        accumulate and are applied in ONE `Updater.update_all` at scope
+        close, so a FusedUpdater turns a whole push's updates into a few
+        donated jit calls (parallel/fused_update.py). Returns None when
+        inactive (no updater, an updater without `update_all`, a nested
+        scope, or repeated keys — per-key semantics run the updater once
+        per occurrence, which the keyed pending dict could not express).
+        Row-sparse keys keep running per key."""
+        if self._pending_updates is not None or self._updater is None \
+                or not hasattr(self._updater, "update_all") \
+                or len(set(keys)) != len(keys):
+            return None
+        self._pending_updates = {}
+        return self._pending_updates
+
+    def _flush_update_batch(self, batch):
+        """Close a batched-update scope, applying collected merges in
+        issue order. A retried `_push_one` overwrote its slot (the dict
+        is keyed), so a replay never double-applies."""
+        if batch is None:
+            return
+        self._pending_updates = None
+        if batch:
+            keys = list(batch)
+            self._updater.update_all(
+                [_updater_key(k) for k in keys],
+                [NDArray(batch[k]) for k in keys],
+                [self._data[k] for k in keys])
 
     def _push_one(self, k, v):
         """One key's push — the retry unit. `chaos_point` precedes all
@@ -235,7 +272,11 @@ class KVStore:
                                                         None):
             merged = jax.device_put(merged, tgt.sharding)
         if self._updater is not None:
-            self._updater(_updater_key(k), NDArray(merged), self._data[k])
+            if self._pending_updates is not None:
+                self._pending_updates[k] = merged
+            else:
+                self._updater(_updater_key(k), NDArray(merged),
+                              self._data[k])
         else:
             self._data[k]._data = merged
 

@@ -102,9 +102,17 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         kvstore.push_all(names, kv_grads, priorities=prios)
         kvstore.pull_all(names, kv_grads, priorities=prios)
     for dev_updates in updates:
-        for upd in dev_updates:
-            i, g, w = upd
-            updater(i, g, w)
+        if not dev_updates:
+            continue
+        if hasattr(updater, "update_all"):
+            # whole set in one call: FusedUpdater groups it into a few
+            # donated jit updates (parallel/fused_update.py)
+            updater.update_all([u[0] for u in dev_updates],
+                               [u[1] for u in dev_updates],
+                               [u[2] for u in dev_updates])
+        else:
+            for i, g, w in dev_updates:
+                updater(i, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -119,7 +119,7 @@ class NDArray:
     """A device array with eager, asynchronous semantics."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "_tape_index", "_stype", "__weakref__")
+                 "_tape_index", "_stype", "_fresh_grad", "__weakref__")
 
     def __init__(self, data, ctx=None, _stype="default"):
         if isinstance(data, NDArray):
@@ -133,6 +133,10 @@ class NDArray:
         self._tape_node = None
         self._tape_index = 0
         self._stype = _stype
+        # set True on the GRAD array by autograd's writeback, cleared
+        # by Trainer after consuming it (the reference's _fresh_grad;
+        # backs step(ignore_stale_grad=True))
+        self._fresh_grad = False
         with _live_lock:
             _live_arrays.add(self)
 

@@ -182,6 +182,8 @@ def _counters_snapshot():
     pack_s, _ = _hist_totals("kvstore.bucket.pack.seconds")
     unpack_s, _ = _hist_totals("kvstore.bucket.unpack.seconds")
     ar_s, _ = _hist_totals("kvstore.allreduce.seconds")
+    fused_pack_s, _ = _hist_totals("optimizer.fused.pack.seconds")
+    fused_update_s, _ = _hist_totals("optimizer.fused.update.seconds")
     return {
         "compile_count": COMPILE_COUNT.total(),
         "compile_seconds": COMPILE_SECONDS.total(),
@@ -194,6 +196,13 @@ def _counters_snapshot():
         "bucket_fill_sum": fill_sum,
         "bucket_pack_seconds": pack_s,
         "bucket_unpack_seconds": unpack_s,
+        # optimizer-update family (optimizer.py / parallel/fused_update):
+        # dispatches/step drops to the fused group count when fusion is
+        # on — tools/telemetry_report.py's optimizer section
+        "update_dispatches": _counter_total("optimizer.update.dispatches"),
+        "fused_groups": _counter_total("optimizer.fused.groups"),
+        "fused_pack_seconds": fused_pack_s,
+        "fused_update_seconds": fused_update_s,
     }
 
 
@@ -294,7 +303,9 @@ class StepTimer:
         for field in ("allreduce_calls", "allreduce_bytes",
                       "allreduce_seconds", "bucket_count",
                       "bucket_fill_sum", "bucket_pack_seconds",
-                      "bucket_unpack_seconds"):
+                      "bucket_unpack_seconds", "update_dispatches",
+                      "fused_groups", "fused_pack_seconds",
+                      "fused_update_seconds"):
             delta = snap[field] - prev.get(field, 0)
             if delta:
                 record[field] = delta

@@ -14,7 +14,6 @@ multi_precision flag.
 """
 from __future__ import annotations
 
-import functools
 import math
 import pickle
 
@@ -22,13 +21,47 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .ndarray import NDArray
+from .observability import registry as _obs
 
 __all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "FTML",
            "DCASGD", "LBSGD", "Test", "Updater", "get_updater", "create",
            "register"]
+
+# every optimizer-update computation dispatched to the device: one per
+# per-parameter call, one per fused group (parallel/fused_update.py) —
+# the per-step delta is how tests assert the O(n_params) -> O(n_groups)
+# dispatch drop
+_UPDATE_DISPATCHES = _obs.counter(
+    "optimizer.update.dispatches",
+    "Optimizer update computations dispatched (per-param + fused-group)")
+
+def donate_update_enabled():
+    """Buffer donation for the update jits (weights/optimizer state
+    only — never grads, which other code may still read): XLA reuses
+    the donated input storage for the same-shaped output, so
+    steady-state updates allocate nothing. MXTPU_DONATE_UPDATE=0
+    restores allocate-and-swap (docs/performance.md aliasing caveat).
+    Re-read per call so the opt-out works after import — the jit
+    wrappers below are cached per flag value."""
+    return getenv("MXTPU_DONATE_UPDATE", True)
+
+
+_KERNEL_JITS = {}
+
+
+def _jit_update_kernel(name, fn, static_argnums, donate_argnums):
+    """Per-(kernel, donation-flag) jit wrapper cache for the per-op
+    update kernels; jax.jit's own cache handles shapes/statics."""
+    donate = donate_argnums if donate_update_enabled() else ()
+    key = (name, donate)
+    jitted = _KERNEL_JITS.get(key)
+    if jitted is None:
+        jitted = _KERNEL_JITS[key] = jax.jit(
+            fn, static_argnums=static_argnums, donate_argnums=donate)
+    return jitted
 
 
 class Optimizer:
@@ -90,9 +123,21 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def _is_multi_precision_state(self, weight, state):
+        """True when `state` is the (fp32 master, base_state) pair
+        create_state_multi_precision builds for low-precision weights.
+        The dtype checks matter: a tuple-state optimizer (Adam's
+        (mean, var)) on fp32 weights is NOT a master/base pair even
+        with multi_precision=True — misreading it would unpack mean as
+        the master weight. Shared with the fused path
+        (parallel/fused_update.py) so both agree on every input."""
+        return (self.multi_precision and isinstance(state, tuple)
+                and len(state) == 2 and isinstance(state[0], NDArray)
+                and state[0]._data.dtype == jnp.float32
+                and state[0]._data.dtype != weight._data.dtype)
+
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and isinstance(state, tuple) and \
-                isinstance(state[0], NDArray):
+        if self._is_multi_precision_state(weight, state):
             master, base_state = state
             g32 = NDArray(grad._data.astype(jnp.float32))
             self.update(index, master, g32, base_state)
@@ -140,28 +185,30 @@ class Optimizer:
         self.num_update = max(self._index_update_count[index],
                               self.num_update)
 
+    def _resolved_mult(self, index, attr):
+        """The per-index multiplier ('lr_mult' or 'wd_mult') with the
+        param_dict -> mult-table -> idx2name resolution chain. The ONE
+        copy of the chain: _get_lr/_get_wd scale by it, and the fused
+        update (parallel/fused_update.py) uses it as the stable group
+        lane identity, so the two can never drift apart."""
+        if index in self.param_dict:
+            return float(getattr(self.param_dict[index], attr))
+        table = getattr(self, attr)
+        if index in table:
+            return float(table[index])
+        if index in self.idx2name:
+            return float(table.get(self.idx2name[index], 1.0))
+        return 1.0
+
     def _get_lr(self, index):
         if self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)
         else:
             lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        return lr * self._resolved_mult(index, "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._resolved_mult(index, "wd_mult")
 
     def __getstate__(self):
         d = self.__dict__.copy()
@@ -187,13 +234,17 @@ def _prep(grad, rescale, clip, wd, weight):
 # per step, so lr IS a traced arg while wd/clip/momentum are static).
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
-def _sgd_kernel(weight, grad, lr, rescale, clip, wd, momentum, mom=None):
+def _sgd_math(weight, grad, lr, rescale, clip, wd, momentum, mom=None):
     g = _prep(grad, rescale, clip, wd, weight)
     if momentum:
         mom = momentum * mom - lr * g
         return weight + mom, mom
     return weight - lr * g, None
+
+
+def _sgd_kernel(*args):
+    return _jit_update_kernel("sgd", _sgd_math, (3, 4, 5, 6),
+                              (0, 7))(*args)
 
 
 @register
@@ -217,10 +268,12 @@ class SGD(Optimizer):
         wd = self._get_wd(index)
         if getattr(grad, "stype", "default") == "row_sparse":
             grad = grad.tostype("default")
+        # momentum-less updates pass mom=None (an empty pytree): a dummy
+        # array would be donated with no matching output and warn
         new_w, new_m = _sgd_kernel(
             weight._data, grad._data, lr, self.rescale_grad,
             self.clip_gradient, wd, self.momentum,
-            state._data if state is not None else jnp.zeros((), weight._data.dtype))
+            state._data if state is not None else None)
         weight._data = new_w
         if state is not None and new_m is not None:
             state._data = new_m
@@ -292,9 +345,8 @@ class SGLD(Optimizer):
         weight._data = weight._data - lr / 2 * g + noise
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
-def _adam_kernel(weight, grad, mean, var, lr, beta1, beta2, epsilon,
-                 rescale, clip, wd, t=1):
+def _adam_math(weight, grad, mean, var, lr, beta1, beta2, epsilon,
+               rescale, clip, wd, t=1):
     g = _prep(grad, rescale, clip, wd, weight)
     mean = beta1 * mean + (1 - beta1) * g
     var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -303,6 +355,11 @@ def _adam_kernel(weight, grad, mean, var, lr, beta1, beta2, epsilon,
     lr_t = lr * (coef2 ** 0.5) / coef1
     w = weight - lr_t * mean / (jnp.sqrt(var) + epsilon)
     return w, mean, var
+
+
+def _adam_kernel(*args):
+    return _jit_update_kernel("adam", _adam_math, (5, 6, 7, 8, 9, 10),
+                              (0, 2, 3))(*args)
 
 
 @register
@@ -335,6 +392,52 @@ class Adam(Optimizer):
         var._data = v
 
 
+# RMSProp/AdaGrad math in the fused-kernel signature
+# (w, g, states, lr, t, wd, hyper): the per-key jits below AND the
+# fused group jits (parallel/fused_update.py) wrap this SAME function,
+# so both paths trace identical jaxprs — the structural guarantee
+# behind the bit-parity contract (an eager per-key path would let XLA
+# make different fusion/FMA choices than the fused kernel).
+
+
+def _adagrad_math(weight, grad, states, lr, t, wd, hyper):
+    epsilon, rescale, clip = hyper
+    g = _prep(grad, rescale, clip, wd, weight)
+    hist = states[0] + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(hist) + epsilon), (hist,)
+
+
+def _rmsprop_math(weight, grad, states, lr, t, wd, hyper):
+    gamma1, gamma2, epsilon, centered, clip_weights, rescale, clip = hyper
+    g = _prep(grad, rescale, clip, wd, weight)
+    if centered:
+        n, gm, delta = states
+        n_ = gamma1 * n + (1 - gamma1) * jnp.square(g)
+        gm_ = gamma1 * gm + (1 - gamma1) * g
+        d_ = gamma2 * delta - lr * g / jnp.sqrt(
+            n_ - jnp.square(gm_) + epsilon)
+        w = weight + d_
+        new_states = (n_, gm_, d_)
+    else:
+        (n,) = states
+        n_ = (1 - gamma1) * jnp.square(g) + gamma1 * n
+        w = weight - lr * g / jnp.sqrt(n_ + epsilon)
+        new_states = (n_,)
+    if clip_weights:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_states
+
+
+def _adagrad_kernel(*args):
+    return _jit_update_kernel("adagrad", _adagrad_math, (5, 6),
+                              (0, 2))(*args)
+
+
+def _rmsprop_kernel(*args):
+    return _jit_update_kernel("rmsprop", _rmsprop_math, (5, 6),
+                              (0, 2))(*args)
+
+
 @register
 class AdaGrad(Optimizer):
     """AdaGrad (reference: optimizer.py:1076)."""
@@ -350,12 +453,13 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
-                  weight._data)
-        hist = state._data + jnp.square(g)
+        new_w, (hist,) = _adagrad_kernel(
+            weight._data, grad._data, (state._data,), lr,
+            self._index_update_count[index], wd,
+            (self.float_stable_eps, self.rescale_grad,
+             self.clip_gradient))
         state._data = hist
-        weight._data = weight._data - lr * g / (
-            jnp.sqrt(hist) + self.float_stable_eps)
+        weight._data = new_w
 
 
 @register
@@ -382,24 +486,14 @@ class RMSProp(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        g = _prep(grad._data, self.rescale_grad, self.clip_gradient, wd,
-                  weight._data)
-        if self.centered:
-            n, gm, delta = state
-            n_ = self.gamma1 * n._data + (1 - self.gamma1) * jnp.square(g)
-            gm_ = self.gamma1 * gm._data + (1 - self.gamma1) * g
-            d_ = self.gamma2 * delta._data - lr * g / jnp.sqrt(
-                n_ - jnp.square(gm_) + self.epsilon)
-            n._data, gm._data, delta._data = n_, gm_, d_
-            w = weight._data + d_
-        else:
-            (n,) = state
-            n_ = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
-            n._data = n_
-            w = weight._data - lr * g / jnp.sqrt(n_ + self.epsilon)
-        if self.clip_weights:
-            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
-        weight._data = w
+        new_w, new_states = _rmsprop_kernel(
+            weight._data, grad._data, tuple(s._data for s in state), lr,
+            self._index_update_count[index], wd,
+            (self.gamma1, self.gamma2, self.epsilon, self.centered,
+             self.clip_weights, self.rescale_grad, self.clip_gradient))
+        for s, ns in zip(state, new_states):
+            s._data = ns
+        weight._data = new_w
 
 
 @register
@@ -659,8 +753,37 @@ class Updater:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
+        elif not self.states_synced.get(index, True):
+            # states adopted via set_states: align context lazily on
+            # first use, like the reference Updater (optimizer.py:1573)
+            self.states[index] = self.sync_state_context(
+                self.states[index], weight._ctx)
+            self.states_synced[index] = True
+        _UPDATE_DISPATCHES.inc()
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    def update_all(self, indices, grads, weights):
+        """Batched update over parallel (index, grad, weight) lists.
+        The base implementation is the per-key loop; FusedUpdater
+        (parallel/fused_update.py) overrides it with grouped, donated
+        single-jit updates. Call sites (Trainer, KVStore, model) hand
+        the WHOLE set here so fusion can see it."""
+        for i, g, w in zip(indices, grads, weights):
+            self(i, g, w)
+
+    def sync_state_context(self, state, context):
+        """Recursively re-home optimizer state onto `context`
+        (reference: optimizer.py Updater.sync_state_context). Dtypes
+        are preserved — in particular fp32 master weights of
+        multi-precision states stay fp32."""
+        if isinstance(state, NDArray):
+            return state.as_in_context(context) if context is not None \
+                else state
+        if isinstance(state, (list, tuple)):
+            return type(state)(self.sync_state_context(s, context)
+                               for s in state)
+        return state
 
     def set_states(self, states):
         states = pickle.loads(states)
@@ -676,4 +799,12 @@ class Updater:
 
 
 def get_updater(optimizer):
-    return Updater(optimizer)
+    """An updater for kvstore/trainer/module drive loops. Returns the
+    fusing variant (parallel/fused_update.py) — it degrades to the
+    per-key path per call for unsupported optimizers, sparse keys, or
+    MXTPU_FUSED_UPDATE=0, so it is always a safe default."""
+    try:
+        from .parallel.fused_update import FusedUpdater
+    except ImportError:
+        return Updater(optimizer)
+    return FusedUpdater(optimizer)

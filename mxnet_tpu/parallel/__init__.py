@@ -21,6 +21,7 @@ from .ring_attention import ring_attention, local_attention, RingAttention
 from .pipeline import pipeline_apply
 from .moe import moe_ffn, moe_ffn_dense, moe_gating, ExpertParallelMoE
 from .bucketing import GradBucketer
+from .fused_update import FusedUpdater, update_cost
 from .kvstore_dist import DistKVStore, init_distributed
 from . import checkpoint  # sharded/async TrainerCheckpoint (orbax)
 from .prefetch import DevicePrefetcher, stage_databatch
@@ -31,4 +32,5 @@ __all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
            "ring_attention", "local_attention", "RingAttention",
            "pipeline_apply", "moe_ffn", "moe_ffn_dense", "moe_gating",
            "ExpertParallelMoE", "DistKVStore", "init_distributed",
-           "GradBucketer", "DevicePrefetcher", "stage_databatch"]
+           "GradBucketer", "FusedUpdater", "update_cost",
+           "DevicePrefetcher", "stage_databatch"]

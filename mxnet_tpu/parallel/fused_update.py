@@ -1,0 +1,372 @@
+"""Fused, donated optimizer step: one compiled update per group.
+
+PR 3 collapsed the gradient exchange into a few fused collectives; this
+module does the same for the weight update. The reference pays one
+engine op per parameter per step (src/operator/optimizer_op.cc kernels
+driven by kvstore/updater loops), and our per-op jits in optimizer.py
+kept that dispatch shape. "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv:2004.13336) identifies the
+weight-update phase as the dominant non-overlappable cost in
+data-parallel training — dispatch overhead on a ~160-parameter ResNet
+is pure loss.
+
+`FusedUpdater` (a drop-in `optimizer.Updater`) groups trainable
+parameters by (optimizer class, packed dtype, multi-precision,
+`lr_mult`/`wd_mult` lanes, update count), packs each group's weights,
+grads, and optimizer-state leaves into flat fusion buffers — **reusing
+the `GradBucketer` layout machinery from PR 3** with an unbounded
+bucket target, so plans are memoized exactly like exchange buckets and
+grads arriving from `push_all`/`pull_all` bucket slices concatenate
+back into contiguous flats without a host round-trip — and runs ONE
+`jax.jit` update per group with `donate_argnums` on the weight and
+state buffers: XLA writes the new values into the donated storage, so
+a steady-state step allocates no fresh weight/state buffers.
+
+Bit parity: every fused kernel repeats the *exact* elementwise
+expressions of the per-parameter path in optimizer.py (same `_prep`,
+same operand order). Elementwise float ops are IEEE-deterministic per
+element, so fused and per-parameter updates are bit-identical
+(asserted in tests/test_fused_update.py).
+
+Fallbacks (always bit-exact, per-key):
+- ``MXTPU_FUSED_UPDATE=0`` (re-read per call),
+- optimizer classes without a fused kernel (exact-type match: a
+  subclass with its own `update` never rides a parent's kernel),
+- row-sparse grads/weights, multi-device grad lists, malformed states.
+
+Donation caveat (docs/performance.md): a donated buffer's old
+`jax.Array` handle is invalidated. The framework's own aliases are
+re-pointed immediately after the call, but external code that captured
+a parameter's raw `.asjax()` array before a step must not read it
+after; set ``MXTPU_DONATE_UPDATE=0`` to keep the old allocate-and-swap
+behavior.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ..base import getenv
+from ..ndarray import NDArray
+from ..observability import registry as _obs
+from .. import optimizer as opt
+from ..optimizer import _prep, _UPDATE_DISPATCHES
+from .bucketing import GradBucketer
+
+__all__ = ["FusedUpdater", "fused_enabled", "donate_enabled",
+           "update_cost"]
+
+# effectively unbounded bucket target: one fusion buffer per group lane
+_NO_LIMIT = 1 << 62
+
+FUSED_GROUPS = _obs.counter(
+    "optimizer.fused.groups",
+    "Fused optimizer groups dispatched (one donated jit call each)")
+FUSED_PACK_SECONDS = _obs.histogram(
+    "optimizer.fused.pack.seconds",
+    "Host time packing one group's weights/grads/states into flats")
+FUSED_UPDATE_SECONDS = _obs.histogram(
+    "optimizer.fused.update.seconds",
+    "Wall time dispatching one fused group update (async dispatch)")
+
+
+def fused_enabled():
+    """MXTPU_FUSED_UPDATE gate, re-read per call so tests/jobs can
+    toggle without re-importing; default on."""
+    return getenv("MXTPU_FUSED_UPDATE", True)
+
+
+def donate_enabled():
+    """MXTPU_DONATE_UPDATE gate for buffer donation on the fused jits —
+    the SAME re-read-per-call flag the per-op kernels honor."""
+    return opt.donate_update_enabled()
+
+
+# ---------------------------------------------------------------------------
+# fused kernels — each repeats the per-key math of optimizer.py exactly
+# ---------------------------------------------------------------------------
+# Shared signature: fn(w, g, states, lr, t, wd, hyper) -> (w', states')
+#   w, g    flat fusion buffers;  states  tuple of flat state buffers
+#   lr, t   traced (lr changes per step via schedulers; t is the
+#           per-cohort update count, traced like _adam_kernel's)
+#   wd      static per group (the per-key jits treat it static too)
+#   hyper   static tuple of the optimizer's global hyperparameters
+
+
+def _sgd_fused(w, g, states, lr, t, wd, hyper):
+    rescale, clip, momentum = hyper
+    g = _prep(g, rescale, clip, wd, w)
+    if momentum:
+        m = momentum * states[0] - lr * g
+        return w + m, (m,)
+    return w - lr * g, ()
+
+
+def _adam_fused(w, g, states, lr, t, wd, hyper):
+    beta1, beta2, epsilon, rescale, clip = hyper
+    mean, var = states
+    g = _prep(g, rescale, clip, wd, w)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * (coef2 ** 0.5) / coef1
+    w = w - lr_t * mean / (jnp.sqrt(var) + epsilon)
+    return w, (mean, var)
+
+
+# RMSProp/AdaGrad reuse the exact math function the per-key jitted
+# kernels wrap (optimizer._rmsprop_math/_adagrad_math): identical
+# source function → identical jaxpr → bit-identical results.
+_rmsprop_fused = opt._rmsprop_math
+_adagrad_fused = opt._adagrad_math
+
+
+class _Spec:
+    """One optimizer class's fused-kernel contract."""
+
+    __slots__ = ("name", "fn", "n_states", "hyper", "cost")
+
+    def __init__(self, name, fn, n_states, hyper, cost):
+        self.name = name
+        self.fn = fn
+        self.n_states = n_states   # opt -> number of flat state buffers
+        self.hyper = hyper         # opt -> static hyperparameter tuple
+        self.cost = cost           # opt -> (reads, writes, flops)/elem
+
+
+_SUPPORTED = {
+    opt.SGD: _Spec(
+        "sgd", _sgd_fused,
+        lambda o: 1 if o.momentum else 0,
+        lambda o: (o.rescale_grad, o.clip_gradient, o.momentum),
+        lambda o: (3, 2, 5) if o.momentum else (2, 1, 3)),
+    opt.Adam: _Spec(
+        "adam", _adam_fused,
+        lambda o: 2,
+        lambda o: (o.beta1, o.beta2, o.epsilon, o.rescale_grad,
+                   o.clip_gradient),
+        lambda o: (4, 3, 11)),
+    opt.RMSProp: _Spec(
+        "rmsprop", _rmsprop_fused,
+        lambda o: 3 if o.centered else 1,
+        lambda o: (o.gamma1, o.gamma2, o.epsilon, o.centered,
+                   o.clip_weights, o.rescale_grad, o.clip_gradient),
+        lambda o: (5, 4, 14) if o.centered else (3, 2, 8)),
+    opt.AdaGrad: _Spec(
+        "adagrad", _adagrad_fused,
+        lambda o: 1,
+        lambda o: (o.float_stable_eps, o.rescale_grad, o.clip_gradient),
+        lambda o: (3, 2, 6)),
+}
+
+_JITS = {}
+
+
+def _jit_for(spec, donate):
+    """The jitted fused kernel for one optimizer class. jax.jit's own
+    cache handles per-(shape, static-hyper) specialization; donation
+    covers the weight flat (0) and every state flat (2)."""
+    key = (spec.name, bool(donate))
+    fn = _JITS.get(key)
+    if fn is None:
+        fn = _JITS[key] = jax.jit(
+            spec.fn, static_argnums=(5, 6),
+            donate_argnums=(0, 2) if donate else ())
+    return fn
+
+
+def update_cost(optimizer, n_elems, itemsize=4):
+    """Estimated FLOPs and HBM bytes of the fused update phase for
+    `n_elems` parameters under `optimizer` — so MFU/roofline accounting
+    (tools/mfu_probe.py) includes the optimizer, not just fwd/bwd.
+    Returns None for optimizers without a fused kernel."""
+    spec = _SUPPORTED.get(type(optimizer))
+    if spec is None:
+        return None
+    reads, writes, flops = spec.cost(optimizer)
+    return {"reads": reads, "writes": writes,
+            "bytes": (reads + writes) * int(n_elems) * int(itemsize),
+            "flops": flops * int(n_elems)}
+
+
+class _Entry:
+    """One fused-eligible parameter's resolved update inputs."""
+
+    __slots__ = ("index", "weight", "pack_w", "grad", "state_leaves",
+                 "master", "lr", "wd", "t", "lane")
+
+    def __init__(self, index, weight, pack_w, grad, state_leaves, master,
+                 lr, wd, t, lane):
+        self.index = index
+        self.weight = weight           # the caller-visible NDArray
+        self.pack_w = pack_w           # jax array packed as the weight
+        self.grad = grad               # jax array, dtype-matched to pack_w
+        self.state_leaves = state_leaves  # list[NDArray], kernel order
+        self.master = master           # fp32 master NDArray or None
+        self.lr = lr
+        self.wd = wd
+        self.t = t
+        self.lane = lane
+
+
+class FusedUpdater(opt.Updater):
+    """Drop-in `optimizer.Updater` whose `update_all` fuses eligible
+    parameters into one donated jit call per group. Per-key `__call__`,
+    `get_states`/`set_states`, and the pickled state format are
+    inherited unchanged, so save/load round-trips are oblivious to
+    fusion."""
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        # PR-3 layout machinery with an unbounded target: one fusion
+        # buffer per (dtype, lane); plans memoized on the item tuple so
+        # steady-state steps pay one dict lookup
+        self._layout = GradBucketer(target_bytes=_NO_LIMIT)
+
+    # -- eligibility ----------------------------------------------------
+    def _collect(self, spec, indices, grads, weights):
+        """Resolve counts/lr/wd and split (fused entries, per-key
+        leftovers), preserving caller order inside each split. Count
+        bookkeeping for fused entries happens here, in caller order —
+        exactly where the per-key path would do it."""
+        o = self.optimizer
+        entries, leftovers = [], []
+        for i, g, w in zip(indices, grads, weights):
+            if isinstance(g, (list, tuple)):
+                if len(g) != 1:
+                    leftovers.append((i, g, w))
+                    continue
+                g = g[0]
+            if i not in self.states:
+                self.states[i] = o.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            elif not self.states_synced.get(i, True):
+                self.states[i] = self.sync_state_context(self.states[i],
+                                                         w._ctx)
+                self.states_synced[i] = True
+            state = self.states[i]
+            if getattr(g, "stype", "default") != "default" or \
+                    getattr(w, "stype", "default") != "default":
+                leftovers.append((i, g, w))
+                continue
+            # multi-precision detection: THE SAME predicate the per-key
+            # path branches on, so fused and fallback always agree
+            mp = o._is_multi_precision_state(w, state)
+            if mp:
+                master, base = state
+                pack_w = master._data
+            else:
+                master, base = None, state
+                pack_w = w._data
+            # mp grads stay raw here and are cast to fp32 ONCE per
+            # packed group (cast commutes with concat elementwise, so
+            # parity holds) — a per-param astype would re-introduce
+            # O(n_params) host dispatches
+            g_arr = g._data
+            if g_arr.dtype != w._data.dtype or g_arr.shape != pack_w.shape:
+                leftovers.append((i, g, w))
+                continue
+            n = spec.n_states(o)
+            if n == 0:
+                leaves = [] if base is None else None
+            else:
+                raw = base if isinstance(base, (list, tuple)) else (base,)
+                leaves = list(raw) if len(raw) == n and all(
+                    isinstance(s, NDArray)
+                    and s._data.dtype == pack_w.dtype
+                    and s._data.shape == pack_w.shape for s in raw) \
+                    else None
+            if leaves is None:
+                leftovers.append((i, g, w))
+                continue
+            o._update_count(i)
+            # lane: the stable group identity — raw weight dtype rides
+            # along so mp groups never mix fp16 and bf16 grads in one
+            # packed buffer (the flat itself is master-fp32 for mp)
+            lane = (spec.name, mp, str(w._data.dtype),
+                    o._resolved_mult(i, "lr_mult"),
+                    o._resolved_mult(i, "wd_mult"))
+            entries.append(_Entry(i, w, pack_w, g_arr, leaves, master,
+                                  o._get_lr(i), o._get_wd(i),
+                                  o._index_update_count[i], lane))
+        return entries, leftovers
+
+    # -- the fused step -------------------------------------------------
+    def update_all(self, indices, grads, weights):
+        """Apply the optimizer to the whole (index, grad, weight) set:
+        a few donated jit calls for the fused groups, the inherited
+        per-key path for everything else — bit-identical either way."""
+        spec = _SUPPORTED.get(type(self.optimizer))
+        if spec is None or not fused_enabled() or len(indices) < 2:
+            super().update_all(indices, grads, weights)
+            return
+        entries, leftovers = self._collect(spec, indices, grads, weights)
+        # update counts for fused entries already happened in _collect;
+        # they must NOT be rerouted through per-key __call__ (update()
+        # would bump the count again). A 1-entry group still runs the
+        # fused kernel — same math, one dispatch.
+        #
+        # cohort key is (t, lr, wd), not just t: with an lr_scheduler
+        # and skewed update counts, two same-t entries can resolve
+        # DIFFERENT lr values mid-collection (the scheduler reads the
+        # global num_update another entry just bumped) — the per-key
+        # path would honor each, so the fused groups must too
+        by_cohort = {}
+        for pos, e in enumerate(entries):
+            by_cohort.setdefault((e.t, e.lr, e.wd), []).append((pos, e))
+        donate = donate_enabled()
+        if len(self._layout._plans) > 64:
+            # membership churn (a trainable subset that varies per
+            # step) would grow the memoized layouts without bound;
+            # steady-state training holds exactly one plan. Each new
+            # membership still costs an XLA retrace — models with
+            # per-step subsets should run MXTPU_FUSED_UPDATE=0
+            # (docs/performance.md).
+            self._layout.clear()
+        for (t, _lr, _wd), cohort in sorted(by_cohort.items()):
+            items = tuple(
+                (e.index, tuple(e.pack_w.shape), str(e.pack_w.dtype),
+                 -pos, e.lane)
+                for pos, e in cohort)
+            by_index = {e.index: e for _, e in cohort}
+            for bucket in self._layout.plan(items):
+                self._run_group(spec, bucket,
+                                [by_index[k] for k in bucket.keys],
+                                t, donate)
+        for i, g, w in leftovers:
+            self(i, g, w)
+
+    def _run_group(self, spec, bucket, group, t, donate):
+        o = self.optimizer
+        n_states = spec.n_states(o)
+        t0 = time.perf_counter()
+        w_flat = bucket.pack([e.pack_w for e in group])
+        g_flat = bucket.pack([e.grad for e in group])
+        if g_flat.dtype != w_flat.dtype:
+            # multi-precision group: ONE fp32 cast of the whole flat
+            # (bit-identical to the per-key per-param casts — astype is
+            # elementwise, so it commutes with concatenation)
+            g_flat = g_flat.astype(w_flat.dtype)
+        state_flats = tuple(
+            bucket.pack([e.state_leaves[s]._data for e in group])
+            for s in range(n_states))
+        FUSED_PACK_SECONDS.observe(time.perf_counter() - t0)
+        lr, wd = group[0].lr, group[0].wd
+        t0 = time.perf_counter()
+        new_w, new_states = _jit_for(spec, donate)(
+            w_flat, g_flat, state_flats, lr, t, wd, spec.hyper(o))
+        FUSED_GROUPS.inc()
+        _UPDATE_DISPATCHES.inc()
+        FUSED_UPDATE_SECONDS.observe(time.perf_counter() - t0)
+        for e, w_sub in zip(group, bucket.unpack(new_w)):
+            if e.master is not None:
+                e.master._data = w_sub
+                e.weight._data = w_sub.astype(e.weight._data.dtype)
+            else:
+                e.weight._data = w_sub
+        for s in range(n_states):
+            for e, s_sub in zip(group, bucket.unpack(new_states[s])):
+                e.state_leaves[s]._data = s_sub

@@ -222,14 +222,24 @@ class DistKVStore(KVStore):
         t0 = time.perf_counter()
         nbytes = sum(_nbytes(values[j]) for j in order)
         policy = self._push_policy()
-        for j in sparse_hi:
-            retry_call(self._push_one, keys[j], values[j], policy=policy)
-        if dense:
-            self._push_bucketed([keys[j] for j in dense],
-                                [values[j] for j in dense],
-                                [prios[j] for j in dense])
-        for j in sparse_lo:
-            retry_call(self._push_one, keys[j], values[j], policy=policy)
+        # batched-update scope: the bucketed unpack lands merged values
+        # via _apply_merged, which a FusedUpdater then applies as a few
+        # donated jit calls instead of one updater run per key (keys
+        # are unique here — the dup-key case took the per-key branch)
+        batch = self._begin_update_batch(keys)
+        try:
+            for j in sparse_hi:
+                retry_call(self._push_one, keys[j], values[j],
+                           policy=policy)
+            if dense:
+                self._push_bucketed([keys[j] for j in dense],
+                                    [values[j] for j in dense],
+                                    [prios[j] for j in dense])
+            for j in sparse_lo:
+                retry_call(self._push_one, keys[j], values[j],
+                           policy=policy)
+        finally:
+            self._flush_update_batch(batch)
         _PUSH_BYTES.inc(nbytes)
         _PUSH_CALLS.inc()
         _PUSH_SECONDS.observe(time.perf_counter() - t0)

@@ -145,3 +145,39 @@ def test_compile_cache_default_dir_created_private(bench, monkeypatch):
         st = os.lstat(d)
         assert st.st_uid == os.getuid()
         assert not (st.st_mode & 0o022)
+
+
+def _guard_fallback_env(monkeypatch):
+    """_fallback_to_cpu mutates os.environ directly; pre-register every
+    var it touches so monkeypatch rolls the mutations back."""
+    for var in ("JAX_PLATFORMS", "MXTPU_BENCH_PLATFORM",
+                "MXTPU_BENCH_BATCH", "MXTPU_BENCH_IMG",
+                "MXTPU_BENCH_STEPS", "MXTPU_BENCH_UNROLL",
+                "MXTPU_BENCH_SCORE", "MXTPU_BENCH_EXTRAS"):
+        monkeypatch.setenv(var, "sentinel")
+        monkeypatch.delenv(var)
+
+
+def test_cpu_fallback_pins_platform_and_shrinks(bench, monkeypatch):
+    _guard_fallback_env(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # the wedged pin
+    monkeypatch.setattr(bench, "_apply_platform_override",
+                        lambda: None)  # keep jax out of this test
+    bench._fallback_to_cpu()
+    assert os.environ["MXTPU_BENCH_PLATFORM"] == "cpu"
+    assert os.environ["JAX_PLATFORMS"] == ""
+    # workload shrank to the CI-smoke sizes (CPU-feasible, measured)
+    assert (bench.BATCH, bench.IMG, bench.STEPS, bench.UNROLL) \
+        == (8, 32, 2, 1)
+    assert os.environ["MXTPU_BENCH_SCORE"] == "0"
+    assert os.environ["MXTPU_BENCH_EXTRAS"] == "0"
+
+
+def test_cpu_fallback_respects_explicit_sizes(bench, monkeypatch):
+    _guard_fallback_env(monkeypatch)
+    monkeypatch.setenv("MXTPU_BENCH_BATCH", "4")
+    monkeypatch.setenv("MXTPU_BENCH_STEPS", "2")
+    monkeypatch.setattr(bench, "_apply_platform_override",
+                        lambda: None)
+    bench._fallback_to_cpu()
+    assert (bench.BATCH, bench.STEPS) == (4, 2)

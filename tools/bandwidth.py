@@ -150,6 +150,16 @@ def _run_sweep_worker(args):
         total_bytes += int(np.prod(shape)) * 4
     prios = [-i for i in range(len(keys))]
     calls = obs.REGISTRY.get("kvstore.allreduce.calls")
+    # update phase: consume the reduced grads (bucket-layout slices out
+    # of pull_all) with the fused optimizer step, so the sweep shows
+    # exchange AND update cost per bucket size in one table — the
+    # pack-layout reuse of parallel/fused_update.py is the delta
+    from mxnet_tpu import optimizer as mxopt
+    updater = mxopt.get_updater(
+        mxopt.create("sgd", learning_rate=0.01, momentum=0.9))
+    weights = [mx.nd.zeros(shape) for shape in shapes]
+    pulled = [mx.nd.zeros(shape) for shape in shapes]
+    idxs = list(range(len(keys)))
 
     if rank == 0:
         print("sweep: %d procs  %d params  %.1f MB total payload  "
@@ -168,11 +178,20 @@ def _run_sweep_worker(args):
         n_collectives = (calls.total() - c0) // args.iters
         # ring-allreduce convention: 2*(n-1)/n of the payload per device
         eff_bw = total_bytes * 2 * (nw - 1) / nw / dt
+        kv.pull_all(keys, pulled, priorities=prios)
+        updater.update_all(idxs, pulled, weights)  # warmup + compile
+        jax.block_until_ready([w._data for w in weights])
+        u0 = time.perf_counter()
+        for _ in range(args.iters):
+            updater.update_all(idxs, pulled, weights)
+        jax.block_until_ready([w._data for w in weights])
+        ut = (time.perf_counter() - u0) / args.iters
         if rank == 0:
             label = "per-key" if mb <= 0 else "%g MB" % mb
             print("bucket %-8s  collectives/step %3d  exchange %8.2f ms  "
-                  "effective %6.3f GB/s"
-                  % (label, n_collectives, dt * 1e3, eff_bw / 1e9))
+                  "effective %6.3f GB/s  update %7.2f ms"
+                  % (label, n_collectives, dt * 1e3, eff_bw / 1e9,
+                     ut * 1e3))
         kv.barrier()
     return 0
 

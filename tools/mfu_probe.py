@@ -69,19 +69,51 @@ def batch_probe(batch, **kw):
     return run
 
 
+def optimizer_phase_cost():
+    """Host-only accounting: FLOPs/bytes of the fused update phase at
+    ResNet-50 scale (parallel/fused_update.update_cost), so MFU numbers
+    can include the optimizer phase instead of silently excluding it.
+    Per-step fwd+bwd FLOPs for resnet50 b128 ~ 3 * 4.1 GFLOP * 128."""
+    from mxnet_tpu import optimizer as mxopt
+    from mxnet_tpu.parallel.fused_update import update_cost
+
+    n_params = 25_557_032  # resnet50_v1 classes=1000
+    fwd_bwd_flops = 3 * 4.089e9 * 128
+    out = {"n_params": n_params}
+    for name, kw in (("sgd_momentum", dict(momentum=0.9)),
+                     ("adam", dict())):
+        opt_name = "sgd" if name == "sgd_momentum" else name
+        cost = update_cost(mxopt.create(opt_name, **kw), n_params, 4)
+        out[name] = {
+            "flops": cost["flops"], "bytes": cost["bytes"],
+            "reads_per_elem": cost["reads"],
+            "writes_per_elem": cost["writes"],
+            # how much the optimizer phase adds to a b128 train step's
+            # FLOP count if excluded from the MFU denominator
+            "share_of_b128_step_flops": round(
+                cost["flops"] / (fwd_bwd_flops + cost["flops"]), 6),
+        }
+    return out
+
+
 def update_roofline():
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.ops.pallas_kernels import fused_sgd_momentum
+    from mxnet_tpu import optimizer as mxopt
+    from mxnet_tpu.parallel.fused_update import update_cost
 
     rows, cols = 199680, 128  # ~25.6M fp32 params, lane-aligned
-    nbytes = rows * cols * 4
     rng = np.random.RandomState(0)
     w = jax.device_put(rng.randn(rows, cols).astype("float32"))
     g = jax.device_put(rng.randn(rows, cols).astype("float32"))
     m = jax.device_put(rng.randn(rows, cols).astype("float32"))
     lr, mom = 0.05, 0.9
     iters = 50
+    # the fused update's cost model (3R+2W, 5 flops/elem for
+    # momentum-SGD) — the same accounting the MFU summary uses
+    cost = update_cost(mxopt.create("sgd", momentum=mom,
+                                    learning_rate=lr), rows * cols, 4)
 
     def xla_step(w, g, m):
         m2 = mom * m + g
@@ -101,13 +133,18 @@ def update_roofline():
         out = loop(w, g, m)
         np.asarray(jax.device_get(out[0][:1, :1]))
         dt = time.perf_counter() - t0
-        # 3 reads + 2 writes of nbytes per iteration
-        return 5.0 * nbytes * iters / dt / 1e9
+        return (cost["bytes"] * iters / dt / 1e9,
+                cost["flops"] * iters / dt / 1e9)
 
-    xla = timed(xla_step)
-    pallas = timed(lambda w, g, m: fused_sgd_momentum(w, g, m, lr, mom))
+    xla, xla_gf = timed(xla_step)
+    pallas, pallas_gf = timed(
+        lambda w, g, m: fused_sgd_momentum(w, g, m, lr, mom))
     return {"xla_gb_s": round(xla, 1), "pallas_gb_s": round(pallas, 1),
-            "buffer_mb": round(nbytes / 2**20, 1),
+            "xla_gflop_s": round(xla_gf, 1),
+            "pallas_gflop_s": round(pallas_gf, 1),
+            "update_bytes_per_step": cost["bytes"],
+            "update_flops_per_step": cost["flops"],
+            "buffer_mb": round(rows * cols * 4 / 2**20, 1),
             "note": "3R+2W bytes/iter; v5e HBM spec ~819 GB/s"}
 
 
@@ -174,6 +211,7 @@ def main():
         "with ONE real chip dp=1 so there is nothing to shard — "
         "a single-chip b256 memory fix must come from remat instead")
     _flush()   # devices + the reasoned negative survive even a
+    _record("optimizer_phase_cost", optimizer_phase_cost)  # host-only
     _record("update_roofline", update_roofline)  # first-probe wedge
     _record("bn_fusion", bn_fusion_probe)
     _record("b128_headline", batch_probe(128))

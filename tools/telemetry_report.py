@@ -111,6 +111,23 @@ def summarize(records):
             float(r.get("bucket_pack_seconds", 0.0)) for r in records)
         summary["bucket_unpack_s"] = sum(
             float(r.get("bucket_unpack_seconds", 0.0)) for r in records)
+    # optimizer section (fused weight update, docs/performance.md):
+    # dispatches/step is the O(n_params) -> O(n_groups) headline
+    dispatches = sum(int(r.get("update_dispatches", 0)) for r in records)
+    fused_groups = sum(int(r.get("fused_groups", 0)) for r in records)
+    if dispatches or fused_groups:
+        opt_times = sorted(float(r["optimizer_time"]) for r in records
+                           if "optimizer_time" in r)
+        summary["update_dispatches"] = dispatches
+        summary["update_dispatches_per_step"] = dispatches / len(records)
+        summary["fused_groups"] = fused_groups
+        summary["fused_pack_s"] = sum(
+            float(r.get("fused_pack_seconds", 0.0)) for r in records)
+        summary["fused_update_s"] = sum(
+            float(r.get("fused_update_seconds", 0.0)) for r in records)
+        if opt_times:
+            summary["optimizer_p50_s"] = _percentile(opt_times, 0.50)
+            summary["optimizer_p95_s"] = _percentile(opt_times, 0.95)
     return summary
 
 
@@ -154,6 +171,16 @@ def format_summary(s):
                 "unpack %.3fs"
                 % (s["bucket_count"], 100.0 * s.get("bucket_fill_mean", 0),
                    s["bucket_pack_s"], s["bucket_unpack_s"]))
+    if "update_dispatches" in s:
+        lines.append(
+            "  optimizer   %d dispatches (%.1f/step)  fused groups %d  "
+            "pack %.3fs  update %.3fs"
+            % (s["update_dispatches"], s["update_dispatches_per_step"],
+               s["fused_groups"], s["fused_pack_s"], s["fused_update_s"]))
+        if "optimizer_p50_s" in s:
+            lines.append(
+                "              update phase p50 %.4fs  p95 %.4fs"
+                % (s["optimizer_p50_s"], s["optimizer_p95_s"]))
     return "\n".join(lines)
 
 
