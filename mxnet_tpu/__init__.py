@@ -53,6 +53,7 @@ from . import gluon
 from . import parallel
 from . import observability
 from . import resilience
+from . import serving
 from . import test_utils
 from . import monitor
 from .monitor import Monitor

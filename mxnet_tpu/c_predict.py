@@ -5,6 +5,16 @@ JSON + .params file and binds a forward-only executor; SetInput /
 Forward / GetOutput drive it. The C shim (src/c_api.cc) embeds the
 interpreter and calls `create_predictor` here, keeping the C side to
 marshalling only.
+
+Since the serving subsystem landed, `Predictor` is a thin shim over
+`serving.InferenceEngine` (docs/serving.md): the symbol+params pair is
+frozen once into a single forward-only jit instead of re-binding a full
+executor per model, and `set_input` takes its dtype from the bound
+input array instead of hard-coding float32 (and stages the buffer
+zero-copy instead of aliasing NDArray internals). Every declared input
+rides as a *static* engine input at its exact shape — independent
+leading dims and scalar shapes stay legal, `forward()` never pads, and
+outputs stay byte-for-byte identical to the executor path.
 """
 from __future__ import annotations
 
@@ -16,42 +26,58 @@ __all__ = ["Predictor", "create_predictor"]
 
 
 class Predictor:
-    """A bound forward-only executor with byte-buffer I/O."""
+    """A frozen forward-only model with byte-buffer I/O (MXPredCreate /
+    MXPredSetInput / MXPredForward semantics)."""
 
     def __init__(self, sym, arg_params, aux_params, shapes):
-        from . import context, ndarray
+        from .serving import InferenceEngine
         self._sym = sym
-        args = {}
+        shapes = {k: tuple(v) for k, v in shapes.items()}
         for name in sym.list_arguments():
-            if name in shapes:
-                args[name] = ndarray.zeros(tuple(shapes[name]))
-            elif name in arg_params:
-                args[name] = arg_params[name]
-            else:
+            if name not in shapes and name not in arg_params:
                 raise MXNetError(
                     "predictor: argument %r has neither a declared "
                     "input shape nor a loaded parameter" % name)
-        aux = {name: aux_params[name]
-               for name in sym.list_auxiliary_states()
-               if name in aux_params}
-        self._executor = sym.bind(context.cpu(), args, aux_states=aux,
-                                  grad_req="null")
-        self._inputs = {k: args[k] for k in shapes}
+        # every declared input keeps its EXACT shape (the legacy
+        # contract: independent fixed-shape buffers, scalar shapes
+        # allowed, leading dims need not agree) — the engine feeds them
+        # verbatim as static inputs, so forward() never pads and the
+        # outputs stay byte-for-byte identical to the executor path
+        batch = max([s[0] for s in shapes.values() if s] or [1])
+        self._engine = InferenceEngine.from_symbol(
+            sym, arg_params, aux_params, {},
+            max_batch_size=batch, name="c_predict",
+            static_shapes=shapes)
+        self._shapes = shapes
+        self._dtypes = {n: dt for n, (_, dt)
+                        in self._engine._static_descs.items()}
+        self._staged = {name: np.zeros(shape, self._dtypes[name])
+                        for name, shape in shapes.items()}
 
     def set_input(self, key, buf):
-        """Copy a raw float32 byte buffer into input `key`."""
-        if key not in self._inputs:
+        """Stage a raw byte buffer as input `key`. The dtype comes from
+        the bound input array (float32 unless a loaded parameter of the
+        same name says otherwise). The buffer is parsed zero-copy
+        (`np.frombuffer` view) but SNAPSHOTTED before returning —
+        MXPredSetInput semantics let the caller reuse or mutate the
+        buffer immediately after the call, so staging a live view would
+        silently corrupt earlier inputs."""
+        if key not in self._shapes:
             raise MXNetError("predictor: unknown input %r (have %s)"
-                             % (key, sorted(self._inputs)))
-        arr = self._inputs[key]
-        data = np.frombuffer(buf, dtype=np.float32).reshape(arr.shape)
-        from .ndarray import array
-        new = array(data)
-        arr._data = new._data
+                             % (key, sorted(self._shapes)))
+        shape, dtype = self._shapes[key], self._dtypes[key]
+        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        view = memoryview(buf)
+        if view.nbytes != want:
+            raise MXNetError(
+                "predictor: input %r wants %d bytes (%s %s), got %d"
+                % (key, want, shape, dtype.name, view.nbytes))
+        self._staged[key] = np.frombuffer(buf, dtype=dtype) \
+            .reshape(shape).copy()
         return True
 
     def forward(self):
-        return list(self._executor.forward(is_train=False))
+        return list(self._engine.infer(self._staged))
 
 
 def create_predictor(symbol_json_path, params_path, shapes):

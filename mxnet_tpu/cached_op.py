@@ -59,6 +59,14 @@ class CachedOp:
     def input_names(self):
         return list(self._input_names)
 
+    @property
+    def symbol(self):
+        """The traced graph this op replays — the freeze surface
+        serving.InferenceEngine.from_block builds its forward-only
+        program from (same entries, so engine outputs match the
+        hybridized block bit-for-bit)."""
+        return self._symbol
+
     def _fwd(self, mode):
         if mode not in self._fwd_jits:
             _JIT_BUILDS.inc(op=self._stub.name, mode=mode, direction="fwd")

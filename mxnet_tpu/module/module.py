@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
 from ..context import Context, cpu
 from ..initializer import Uniform, InitDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
@@ -121,6 +121,9 @@ class Module(BaseModule):
         self._grad_req = None
         self._exec_group = None
         self._data_shapes = self._label_shapes = None
+        # predict-only fast path: a serving.InferenceEngine frozen from
+        # this module, rebuilt lazily whenever params/binding change
+        self._serving_engine_obj = None
 
     # -- checkpointing --------------------------------------------------
     @staticmethod
@@ -210,6 +213,7 @@ class Module(BaseModule):
 
         self.params_initialized = True
         self._params_dirty = False
+        self._serving_engine_obj = None
         self._exec_group.set_params(self._arg_params, self._aux_params,
                                     allow_extra=allow_extra)
 
@@ -231,6 +235,7 @@ class Module(BaseModule):
                                     allow_extra=allow_extra)
         self._params_dirty = True
         self.params_initialized = True
+        self._serving_engine_obj = None
 
     # -- binding --------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -285,6 +290,7 @@ class Module(BaseModule):
         self.binded = False
         self._exec_group = None
         self._data_shapes = self._label_shapes = None
+        self._serving_engine_obj = None
 
     def reshape(self, data_shapes, label_shapes=None):
         """Rebind for new batch shapes (reference: module.py:452). XLA
@@ -410,10 +416,69 @@ class Module(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._forward_via_engine(data_batch, is_train):
+            return
         change = self._batch_shape_change(data_batch)
         if change is not None:
             self.reshape(*change)
         self._exec_group.forward(data_batch, is_train)
+
+    def _serving_engine(self):
+        """The serving.InferenceEngine frozen from this module's symbol
+        + current params (predict path; rebuilt after param changes)."""
+        eng = self._serving_engine_obj
+        if not eng:
+            from ..serving import InferenceEngine
+            eng = InferenceEngine.from_module(self, name="module")
+            self._serving_engine_obj = eng
+        return eng
+
+    def _forward_via_engine(self, data_batch, is_train):
+        """Predict-only fast path (docs/serving.md): a module bound
+        `for_training=False` forwards through a frozen InferenceEngine —
+        one compiled dispatch, padding buckets absorbing ragged tail
+        batches instead of a full executor rebind. Writes the outputs
+        into the executor so get_outputs()/update_metric() are none the
+        wiser. Returns False (caller takes the legacy executor path)
+        when disabled via ``MXTPU_SERVING_ENGINE=0``, when a monitor is
+        installed, or when the batch doesn't fit the frozen signature.
+        """
+        if self.for_training or is_train:
+            return False
+        if not getenv("MXTPU_SERVING_ENGINE", True):
+            return False
+        exec_ = self._exec_group.exec_
+        if exec_._monitor_callback is not None:
+            return False
+        batch = data_batch[0] if isinstance(data_batch, list) \
+            else data_batch
+        data = batch.data
+        if data is None or len(data) != len(self._data_names):
+            return False
+        n = None
+        for arr, desc in zip(data, self._data_shapes):
+            shp = tuple(arr.shape)
+            if not shp or shp[1:] != tuple(desc.shape)[1:]:
+                return False          # non-batch dims changed: rebind
+            n = shp[0] if n is None else n
+            if shp[0] != n:
+                return False
+        if self._serving_engine_obj is False:
+            return False      # freeze failed before; don't retry per batch
+        try:
+            eng = self._serving_engine()
+        except MXNetError:
+            # unfreezable module (exotic inputs): cache the failure so
+            # every subsequent batch skips straight to the executor
+            # path instead of re-running the whole graph freeze
+            # (param-change hooks reset this to None for a retry)
+            self._serving_engine_obj = False
+            return False
+        if n > eng.max_batch_size:
+            return False
+        outs = eng.infer(dict(zip(self._data_names, data)))
+        exec_.outputs = outs
+        return True
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized

@@ -9,8 +9,9 @@ missing machinery, wired through the runtime at named sites:
 
 - `chaos`:   seeded, env-driven fault injector (``MXTPU_CHAOS``) with
              named sites (`kvstore.push`, `dist.init`, `checkpoint.save`,
-             `io.read`, `engine.host_push`) so tests and chaos runs can
-             trip failures deterministically (tools/chaos_run.py).
+             `io.read`, `engine.host_push`, `serving.infer`) so tests
+             and chaos runs can trip failures deterministically
+             (tools/chaos_run.py).
 - `retry`:   `RetryPolicy` / `retry()` / `retry_call()` with exponential
              backoff + jitter, `Deadline` contexts, and
              `run_with_deadline` (bounds calls that can hang forever —

@@ -1,0 +1,34 @@
+"""Serving: compiled inference with dynamic micro-batching and load
+shedding (docs/serving.md).
+
+The first subsystem that makes this repo an inference system, not just
+a trainer. Three pillars:
+
+- `engine`:  `InferenceEngine` — a Gluon Block, bound Module, or
+             symbol+params frozen into ONE donated forward-only
+             `jax.jit`, with padding-bucket batch shapes (powers of two
+             up to `max_batch_size`) so arbitrary request sizes hit a
+             bounded compile cache, plus `warmup()` precompilation.
+- `batcher`: `DynamicBatcher` — thread-safe bounded queue coalescing
+             requests up to `max_batch_size` rows or `max_wait_ms`,
+             deadline-aware (`resilience.Deadline`; expired requests
+             are rejected, never computed), with an explicit
+             load-shedding policy (`reject` / `drop_oldest`).
+- `server`:  `ModelServer` — one worker per local device replica with
+             least-loaded dispatch, graceful SIGTERM drain (finish
+             in-flight, reject new — the `PreemptionGuard` shape), and
+             a `stats()` snapshot.
+
+`c_predict.Predictor` and `Module.predict` are thin shims over this
+layer (``MXTPU_SERVING_ENGINE=0`` restores the legacy Module path).
+Chaos site: `serving.infer`. Metrics: `serving.*` in the observability
+registry; per-batch JSONL records ride the ``MXTPU_TELEMETRY`` stream.
+"""
+from .engine import InferenceEngine, bucket_sizes
+from .batcher import (DynamicBatcher, InferenceRequest, RequestRejected,
+                      ServerClosed)
+from .server import ModelServer
+
+__all__ = ["InferenceEngine", "bucket_sizes", "DynamicBatcher",
+           "InferenceRequest", "RequestRejected", "ServerClosed",
+           "ModelServer"]

@@ -1,0 +1,514 @@
+"""InferenceEngine: a model frozen into one donated forward-only jit.
+
+The training side already amortizes XLA dispatch over fused buckets
+(gradients, PR 3) and fused groups (weight updates, PR 4); this applies
+the same lever to requests. A model — a Gluon Block, a bound Module, or
+the symbol+params pair the C predict API loads — is frozen once into a
+single `jax.jit` forward computation with the request batch donated, and
+every request size is rounded up to a **padding bucket** (powers of two
+up to `max_batch_size`) so arbitrary traffic hits a small, bounded
+compile cache: ≤ log2(max_batch_size)+1 XLA programs ever, no matter
+what batch sizes arrive.
+
+Contrast with the paths this replaces:
+- `c_predict.Predictor` re-bound a full gradient-capable executor per
+  model and dispatched one request at a time.
+- `Module.predict` paid the executor-group place/dispatch plumbing per
+  batch and re-bound the whole module when a tail batch changed shape.
+
+Metrics: `serving.engine.compiles` counts one per (engine, bucket) —
+the padding-bucket bound asserted in tests/test_serving.py — and
+`serving.engine.infer.seconds` tracks per-dispatch service time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, getenv
+from ..graph import build_graph_fn, collect_vars, infer_structs
+from ..ndarray import NDArray
+from ..observability import registry as _obs
+
+__all__ = ["InferenceEngine", "bucket_sizes"]
+
+_COMPILES = _obs.counter(
+    "serving.engine.compiles",
+    "padding-bucket forward programs compiled by InferenceEngine")
+_INFER_SECONDS = _obs.histogram(
+    "serving.engine.infer.seconds",
+    "wall time of one InferenceEngine dispatch (pad + compute + wrap)")
+
+
+def bucket_sizes(max_batch_size):
+    """The padding-bucket ladder: powers of two below `max_batch_size`,
+    plus `max_batch_size` itself (so a full batch never pads). The
+    ladder length — ≤ log2(max)+1 — bounds the engine's compile cache."""
+    max_batch_size = int(max_batch_size)
+    if max_batch_size < 1:
+        raise MXNetError("max_batch_size must be >= 1, got %d"
+                         % max_batch_size)
+    sizes = []
+    b = 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return tuple(sizes)
+
+
+class InferenceEngine:
+    """A frozen forward-only model with a bounded compile cache.
+
+    Construct via `from_symbol` / `from_module` / `from_block`, then
+    call `infer({name: array_batch})` (or a bare array when the model
+    has one input). Requests are padded up to the nearest bucket, run
+    through the shared jit, and sliced back to the true row count.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, data_descs,
+                 max_batch_size, name=None, donate=None,
+                 static_shapes=None):
+        # data_descs: [(input_name, per_example_shape, dtype)] — shapes
+        # WITHOUT the leading batch dimension (it varies per bucket).
+        # static_shapes: {name: FULL fixed shape} — inputs fed verbatim
+        # with no padding/slicing (the c_predict contract: independent
+        # fixed-shape buffers, scalars allowed)
+        self._symbol = symbol
+        self.name = name or (symbol.name or "model")
+        self.max_batch_size = int(max_batch_size)
+        self._buckets = bucket_sizes(self.max_batch_size)
+        self._descs = [(str(n), tuple(s), np.dtype(dt))
+                       for n, s, dt in data_descs]
+        self._static = {str(n): tuple(s)
+                        for n, s in (static_shapes or {}).items()}
+        self._data_names = [n for n, _, _ in self._descs] + \
+            sorted(self._static)
+        if not self._data_names:
+            raise MXNetError("InferenceEngine needs at least one data "
+                             "input")
+
+        arg_nodes, aux_nodes = collect_vars(symbol._entries)
+        arg_names = [n.name for n in arg_nodes]
+        aux_names = [n.name for n in aux_nodes]
+        data_set = set(self._data_names)
+        unknown = data_set - set(arg_names)
+        if unknown:
+            raise MXNetError(
+                "InferenceEngine: input(s) %s are not arguments of the "
+                "graph (arguments: %s)" % (sorted(unknown), arg_names))
+        arg_params = arg_params or {}
+        self._param_names = [n for n in arg_names
+                             if n not in data_set and n in arg_params]
+        # arguments that are neither fed data nor loaded params — label
+        # heads like softmax_label that predict mode never reads. The
+        # legacy bind path allocated inferred zeros for them; so do we,
+        # one set per bucket (their shapes track the batch dimension)
+        self._phantom_names = [n for n in arg_names
+                               if n not in data_set
+                               and n not in arg_params]
+        self._phantoms = {}          # bucket -> {name: zeros}
+
+        def take(src, names, kind):
+            out = {}
+            for n in names:
+                if n not in src:
+                    raise MXNetError(
+                        "InferenceEngine: missing %s %r" % (kind, n))
+                v = src[n]
+                out[n] = v._data if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+            return out
+
+        self._params = take(arg_params, self._param_names, "parameter")
+        self._aux = take(aux_params or {}, aux_names, "aux state")
+        self._static_descs = {
+            n: (shape, np.dtype(arg_params[n].dtype
+                                if n in arg_params else np.float32))
+            for n, shape in self._static.items()}
+
+        fn, _, _, needs_rng = build_graph_fn(symbol._entries,
+                                             mode="predict")
+        self._needs_rng = needs_rng
+
+        def fwd(data, params, aux, key):
+            outs, _ = fn({**data, **params}, aux, key)
+            return outs
+
+        # the request batch is step-local by construction (`_pad` always
+        # hands jit a fresh buffer), so donating it lets XLA reuse its
+        # memory for intermediates; params/aux must outlive the call and
+        # are never donated
+        if donate is None:
+            donate = getenv("MXTPU_SERVE_DONATE", True)
+        self._jit = jax.jit(fwd, donate_argnums=(0,) if donate else ())
+        self._lock = threading.Lock()
+        self._compiled = set()      # (bucket, device-key) dispatched OK
+        self._placed = {}           # device-key -> (params, aux) copies
+
+    # ------------------------------------------------------------------
+    # constructors
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_symbol(cls, symbol, arg_params, aux_params, input_shapes,
+                    max_batch_size, input_dtypes=None, name=None,
+                    donate=None, static_shapes=None):
+        """Freeze a symbol + params (the `c_predict` load path).
+
+        `input_shapes`: {name: per-example shape} (no batch dim).
+        `static_shapes`: {name: full fixed shape} fed verbatim with no
+        padding (independent leading dims, scalars allowed).
+        `input_dtypes`: optional {name: dtype}; defaults to the loaded
+        parameter's dtype when a parameter shares the name, else
+        float32."""
+        input_dtypes = input_dtypes or {}
+        descs = []
+        for n, shape in input_shapes.items():
+            dt = input_dtypes.get(n)
+            if dt is None and arg_params and n in arg_params:
+                dt = arg_params[n].dtype
+            descs.append((n, tuple(shape), np.dtype(dt or np.float32)))
+        return cls(symbol, arg_params, aux_params, descs,
+                   max_batch_size, name=name, donate=donate,
+                   static_shapes=static_shapes)
+
+    @classmethod
+    def from_module(cls, module, max_batch_size=None, name=None,
+                    donate=None):
+        """Freeze a bound Module (its symbol, current params, and bound
+        data shapes; `max_batch_size` defaults to the bound batch)."""
+        if not (module.binded and module.params_initialized):
+            raise MXNetError("from_module: module must be bound and "
+                             "initialized")
+        arg_params, aux_params = module.get_params()
+        descs = []
+        batch = None
+        for d in module.data_shapes:
+            if not d.shape:
+                raise MXNetError("from_module: scalar data input %r "
+                                 "has no batch dimension" % d.name)
+            batch = d.shape[0] if batch is None else batch
+            if d.shape[0] != batch:
+                raise MXNetError(
+                    "from_module: data inputs disagree on the batch "
+                    "dimension (%s)" % [tuple(x.shape)
+                                        for x in module.data_shapes])
+            descs.append((d.name, tuple(d.shape[1:]),
+                          np.dtype(getattr(d, "dtype", np.float32))))
+        return cls(module._symbol, arg_params, aux_params, descs,
+                   max_batch_size or batch,
+                   name=name or "module", donate=donate)
+
+    @classmethod
+    def from_block(cls, block, *example_inputs, max_batch_size=None,
+                   name=None, donate=None):
+        """Freeze a Gluon HybridBlock via its CachedOp trace.
+
+        `example_inputs`: NDArrays with the serving per-example shapes
+        (their leading dim seeds `max_batch_size` when not given)."""
+        from ..gluon.block import HybridBlock
+        from ..gluon.parameter import DeferredInitializationError
+        if not isinstance(block, HybridBlock):
+            raise MXNetError(
+                "from_block wants a HybridBlock (traceable to one "
+                "graph); got %s" % type(block).__name__)
+        example_inputs = [x if isinstance(x, NDArray) else NDArray(x)
+                          for x in example_inputs]
+        # reuse the hybridize/CachedOp trace: same graph the block would
+        # replay, so engine outputs match block(x) bit-for-bit
+        if block._cached_op is not None:
+            tracers, graph = (block._cached_graph[0],
+                              block._cached_op.symbol)
+        else:
+            tracers, graph = block._get_graph(*example_inputs)
+        try:
+            params = {p.name: p.data()
+                      for p in block.collect_params().values()}
+        except DeferredInitializationError:
+            block._deferred_infer_shape(*example_inputs)
+            for p in block.collect_params().values():
+                p._finish_deferred_init()
+            params = {p.name: p.data()
+                      for p in block.collect_params().values()}
+        aux_names = set(graph.list_auxiliary_states())
+        arg_params = {k: v for k, v in params.items()
+                      if k not in aux_names}
+        aux_params = {k: v for k, v in params.items() if k in aux_names}
+        descs = []
+        batch = None
+        for t, x in zip(tracers, example_inputs):
+            if not x.shape:
+                raise MXNetError("from_block: example input for %r has "
+                                 "no batch dimension" % t.name)
+            batch = x.shape[0] if batch is None else batch
+            descs.append((t.name, tuple(x.shape[1:]), x.dtype))
+        return cls(graph, arg_params, aux_params, descs,
+                   max_batch_size or batch,
+                   name=name or block.name or "block", donate=donate)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def buckets(self):
+        return self._buckets
+
+    @property
+    def compiled_buckets(self):
+        with self._lock:
+            return sorted({b for b, _ in self._compiled})
+
+    def bucket_for(self, n):
+        """Smallest padding bucket that holds `n` rows."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError("batch size must be >= 1, got %d" % n)
+        if n > self.max_batch_size:
+            raise MXNetError(
+                "batch of %d rows exceeds max_batch_size=%d (split it "
+                "or rebuild the engine)" % (n, self.max_batch_size))
+        for b in self._buckets:
+            if b >= n:
+                return b
+        raise AssertionError("unreachable")
+
+    def set_params(self, arg_params, aux_params=None):
+        """Swap in new parameter values (same names/shapes — the jit
+        cache keys on shapes, so no recompiles)."""
+        for n in self._param_names:
+            if arg_params and n in arg_params:
+                v = arg_params[n]
+                self._params[n] = v._data if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+        for n in list(self._aux):
+            if aux_params and n in aux_params:
+                v = aux_params[n]
+                self._aux[n] = v._data if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+        with self._lock:
+            self._placed = {}     # per-device copies are now stale
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def _phantoms_for(self, bucket, device=None):
+        """Zero buffers for unfed, unloaded graph arguments (label
+        heads), shaped by inference at this bucket's batch size and
+        cached per (bucket, device) — XLA drops them from the predict
+        program anyway."""
+        if not self._phantom_names:
+            return {}
+        cache_key = (bucket, None if device is None else device.id)
+        cached = self._phantoms.get(cache_key)
+        if cached is not None:
+            return cached
+        known = {name: ((bucket,) + shape, dtype)
+                 for name, shape, dtype in self._descs}
+        known.update((n, (shape, dtype))
+                     for n, (shape, dtype) in self._static_descs.items())
+        known.update((n, (tuple(v.shape), v.dtype))
+                     for n, v in self._params.items())
+        known.update((n, (tuple(v.shape), v.dtype))
+                     for n, v in self._aux.items())
+        structs, _ = infer_structs(self._symbol._entries, known,
+                                   mode="predict")
+        out = {}
+        for n in self._phantom_names:
+            s = structs.get(n)
+            if s is None:
+                raise MXNetError(
+                    "InferenceEngine: could not infer a shape for "
+                    "unfed argument %r — declare it as an input or "
+                    "load a parameter for it" % n)
+            z = jnp.zeros(s.shape, s.dtype)
+            out[n] = z if device is None else jax.device_put(z, device)
+        with self._lock:
+            self._phantoms[cache_key] = out
+        return out
+
+    def _weights_on(self, device):
+        """Params/aux placed on `device` (copied once, cached) — the
+        replica set ModelServer workers dispatch against, so a
+        multi-device host genuinely runs one replica per worker instead
+        of serializing every batch on the default device. Built and
+        stored under the lock: a copy built outside it could be staled
+        by a concurrent set_params() and then cached over its
+        invalidation, pinning old weights on this replica forever."""
+        if device is None:
+            return self._params, self._aux
+        key = device.id
+        with self._lock:
+            placed = self._placed.get(key)
+            if placed is None:
+                placed = ({n: jax.device_put(v, device)
+                           for n, v in self._params.items()},
+                          {n: jax.device_put(v, device)
+                           for n, v in self._aux.items()})
+                self._placed[key] = placed
+        return placed
+
+    def _stage_static(self, x, name, shape, dtype, device):
+        """A fixed-shape input fed verbatim (no padding): validate and
+        hand jit a FRESH device buffer (same donation invariant as
+        `_pad`)."""
+        if isinstance(x, NDArray):
+            x = x._data
+        got = tuple(x.shape) if hasattr(x, "shape") else None
+        if got != shape:
+            raise MXNetError("input %r: expected shape %s, got %s"
+                             % (name, shape, got))
+        if isinstance(x, jax.Array):
+            x = x.astype(dtype) if x.dtype != dtype \
+                else jnp.array(x, copy=True)
+        else:
+            x = jnp.asarray(np.asarray(x, dtype=dtype))
+        return x if device is None else jax.device_put(x, device)
+
+    def _pad(self, x, desc, bucket, device=None):
+        """Return a FRESH array of shape (bucket, *example) on `device`
+        (default placement when None) for input `x` of n rows.
+        Freshness is a donation invariant: the jit donates its data
+        buffers, so handing it an array the caller still holds would
+        invalidate the caller's copy."""
+        name, shape, dtype = desc
+        if isinstance(x, NDArray):
+            x = x._data
+        want = x.shape[1:] if hasattr(x, "shape") else None
+        if want != shape:
+            raise MXNetError(
+                "input %r: expected per-example shape %s, got %s"
+                % (name, shape, want))
+        n = x.shape[0]
+        if isinstance(x, jax.Array):
+            if x.dtype != dtype:
+                x = x.astype(dtype)      # fresh
+            elif n == bucket:
+                x = jnp.array(x, copy=True)   # fresh, donation-safe
+            if n < bucket:
+                pad = jnp.zeros((bucket - n,) + shape, dtype)
+                x = jnp.concatenate([x, pad], axis=0)
+            return x if device is None else jax.device_put(x, device)
+        # host array: pad on the host, ONE transfer straight to the
+        # target device
+        x = np.asarray(x, dtype=dtype)
+        if n < bucket:
+            padded = np.zeros((bucket,) + shape, dtype)
+            padded[:n] = x
+            x = padded
+        return jnp.asarray(x) if device is None \
+            else jax.device_put(x, device)
+
+    def infer(self, inputs, n=None, device=None):
+        """Run one coalesced request batch.
+
+        `inputs`: {name: array of shape (n, *example)} or a bare array
+        for single-input models (static inputs take their exact fixed
+        shape). `device` places the batch AND a cached parameter copy
+        on that device (worker-replica dispatch). Returns the model
+        outputs as NDArrays sliced back to `n` rows (padding rows are
+        computed in the bucket-shaped program and discarded)."""
+        t0 = time.perf_counter()
+        if not isinstance(inputs, dict):
+            if len(self._data_names) != 1:
+                raise MXNetError(
+                    "model has inputs %s; pass a dict" % self._data_names)
+            inputs = {self._data_names[0]: inputs}
+        missing = [n_ for n_ in self._data_names if n_ not in inputs]
+        if missing:
+            raise MXNetError("infer: missing input(s) %s" % missing)
+        rows = None
+        for name_, _, _ in self._descs:
+            x = inputs[name_]
+            ln = (x.shape[0] if hasattr(x, "shape") and x.shape
+                  else None)
+            if ln is None:
+                raise MXNetError("input %r has no batch dimension"
+                                 % name_)
+            rows = ln if rows is None else rows
+            if ln != rows:
+                raise MXNetError(
+                    "inputs disagree on the batch dimension (%d vs %d)"
+                    % (ln, rows))
+        if rows is None:          # static-only model (c_predict shim)
+            rows = self.max_batch_size
+        if n is None:
+            n = rows
+        bucket = self.bucket_for(rows)
+        data = {}
+        for d in self._descs:
+            data[d[0]] = self._pad(inputs[d[0]], d, bucket, device)
+        for name_, (shape, dtype) in self._static_descs.items():
+            data[name_] = self._stage_static(inputs[name_], name_,
+                                             shape, dtype, device)
+        compile_key = (bucket, None if device is None else device.id)
+        with self._lock:
+            compiling = compile_key not in self._compiled
+        key = None
+        if self._needs_rng:
+            from .. import random as _random
+            key = _random.next_key()
+        params, aux = self._weights_on(device)
+        phantoms = self._phantoms_for(bucket, device)
+        if phantoms:
+            params = {**params, **phantoms}
+        if compiling:
+            # a forward-only program often can't alias the donated
+            # request buffer into its outputs; that's fine (donation
+            # still frees it for intermediates) — silence XLA's
+            # per-compile nag on the one dispatch that lowers
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                outs = self._jit(data, params, aux, key)
+            # account AFTER the dispatch succeeded: a failed first
+            # dispatch must not mark the bucket warm (warmup() would
+            # skip it) or count a compile that never finished
+            with self._lock:
+                if compile_key not in self._compiled:
+                    self._compiled.add(compile_key)
+                    _COMPILES.inc(engine=self.name, bucket=str(bucket))
+        else:
+            outs = self._jit(data, params, aux, key)
+        keep = None if n == bucket else n
+        result = [NDArray(o[:keep] if keep is not None else o)
+                  for o in outs]
+        _INFER_SECONDS.observe(time.perf_counter() - t0,
+                               engine=self.name)
+        return result
+
+    def warmup(self, buckets=None, device=None):
+        """Precompile the padding buckets (all of them by default) with
+        zero batches, so the first real request never pays an XLA
+        compile; `device` warms that replica's programs. Returns the
+        list of bucket sizes warmed."""
+        warmed = []
+        devkey = None if device is None else device.id
+        statics = {name: np.zeros(shape, dtype)
+                   for name, (shape, dtype) in self._static_descs.items()}
+        if buckets is None:
+            # a static-only model has ONE program (no padded batch
+            # axis); its single "bucket" is the declared size
+            buckets = self._buckets if self._descs \
+                else (self.max_batch_size,)
+        for b in buckets:
+            b = self.bucket_for(b)
+            with self._lock:
+                seen = (b, devkey) in self._compiled
+            if seen:
+                continue
+            zeros = {name: np.zeros((b,) + shape, dtype)
+                     for name, shape, dtype in self._descs}
+            zeros.update(statics)
+            self.infer(zeros, n=b, device=device)
+            warmed.append(b)
+        return warmed

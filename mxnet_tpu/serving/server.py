@@ -1,0 +1,360 @@
+"""ModelServer: worker-replica dispatch, graceful drain, stats.
+
+Completes the serving stack (docs/serving.md): an `InferenceEngine`
+(compiled forward, padding buckets) behind a `DynamicBatcher`
+(coalescing, deadlines, shedding) driven by one worker thread per local
+device replica — the `parallel.mesh` device enumeration reused for
+inference. A dispatcher thread pulls coalesced batches and hands each
+to the **least-loaded** worker (fewest in-flight rows), so a slow
+dispatch on one replica doesn't head-of-line-block the others.
+
+Shutdown mirrors `resilience.PreemptionGuard`'s shape: SIGTERM (under
+`handle_signals()`) or an explicit `drain()` flips the server into
+draining mode — new submits are rejected with `ServerClosed`, queued
+and in-flight batches FINISH, then workers exit. A preempted serving
+replica answers everything it already accepted and sheds the rest to
+its peers.
+
+Per-batch JSONL records (when ``MXTPU_TELEMETRY=<path>`` is set) ride
+the same stream as training StepTimer records, tagged
+``source="serving"``; `tools/telemetry_report.py` renders the serving
+section from them.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+
+from ..base import MXNetError, getenv
+from ..observability import registry as _obs
+from ..observability import telemetry as _telemetry
+from ..resilience import chaos_point
+from .batcher import DynamicBatcher, ServerClosed
+from .engine import InferenceEngine
+
+__all__ = ["ModelServer"]
+
+_BATCH_SECONDS = _obs.histogram(
+    "serving.batch.seconds", "service time of one coalesced batch")
+_REQS_SERVED = _obs.counter("serving.requests.served",
+                            "requests answered successfully")
+_REQS_FAILED = _obs.counter("serving.requests.failed",
+                            "requests answered with an error")
+
+
+def _local_devices():
+    """Local device enumeration (the replica list `parallel.mesh`
+    builds meshes from)."""
+    import jax
+    return jax.local_devices()
+
+
+class _Worker:
+    """One serving replica: a thread draining its private batch queue."""
+
+    def __init__(self, server, index, device):
+        self.server = server
+        self.index = index
+        self.device = device
+        self._queue = []            # guarded by server._lock
+        self.inflight_rows = 0      # guarded by server._lock
+        self.served_requests = 0
+        self.served_batches = 0
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="serving-worker-%d" % index)
+
+    def _loop(self):
+        srv = self.server
+        while True:
+            with srv._lock:
+                while not self._queue and not srv._stopping:
+                    srv._work_ready.wait()
+                if not self._queue and srv._stopping:
+                    return
+                batch = self._queue.pop(0)
+                # a backlog slot opened: the dispatcher may pop the
+                # next coalesced batch from the bounded batcher queue
+                srv._slot_free.notify_all()
+            try:
+                srv._run_batch(self, batch)
+            finally:
+                rows = sum(r.n for r in batch)
+                with srv._lock:
+                    self.inflight_rows -= rows
+                    srv._idle.notify_all()
+
+
+class ModelServer:
+    """Serve an `InferenceEngine` (or any model it can freeze) behind
+    dynamic batching with explicit overload behavior.
+
+        engine = InferenceEngine.from_symbol(sym, args, auxs,
+                                             {"data": (8,)}, 32)
+        server = ModelServer(engine)
+        server.start()
+        handle = server.submit(x)          # x: (n, 8) host array
+        probs = handle.result(timeout=1.0)
+        server.drain()
+    """
+
+    def __init__(self, engine, num_workers=None, max_batch_size=None,
+                 max_wait_ms=None, queue_depth=None, shed_policy=None,
+                 warmup=False):
+        if not isinstance(engine, InferenceEngine):
+            raise MXNetError("ModelServer wants an InferenceEngine; "
+                             "use InferenceEngine.from_* to freeze a "
+                             "model first")
+        self.engine = engine
+        devices = _local_devices()
+        if num_workers is None:
+            num_workers = getenv("MXTPU_SERVE_WORKERS", len(devices))
+        num_workers = max(1, int(num_workers))
+        self.batcher = DynamicBatcher(
+            engine.data_names,
+            max_batch_size=(max_batch_size if max_batch_size is not None
+                            else engine.max_batch_size),
+            max_wait_ms=max_wait_ms, queue_depth=queue_depth,
+            shed_policy=shed_policy, name=engine.name)
+        if self.batcher.max_batch_size > engine.max_batch_size:
+            raise MXNetError(
+                "batcher max_batch_size=%d exceeds the engine's "
+                "compiled bound %d"
+                % (self.batcher.max_batch_size, engine.max_batch_size))
+        self._lock = threading.Lock()
+        self._work_ready = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._slot_free = threading.Condition(self._lock)
+        self._workers = [
+            _Worker(self, i, devices[i % len(devices)])
+            for i in range(num_workers)]
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="serving-dispatch")
+        self._started = False
+        self._stopping = False
+        self._draining = False
+        self._drain_requested = False   # set from signal context
+        self._step = 0
+        self._warmup = bool(warmup)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        if self._warmup:
+            # warm every replica device the workers dispatch on, not
+            # just the default one
+            for dev in {w.device for w in self._workers}:
+                self.engine.warmup(device=dev)
+        self._started = True
+        for w in self._workers:
+            w.thread.start()
+        self._dispatcher.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    @property
+    def draining(self):
+        return self._draining or self._drain_requested
+
+    def drain(self, timeout=None):
+        """Graceful shutdown: reject new submits, FINISH everything
+        already queued or in flight, then stop the threads. Returns
+        True when fully drained (False only on timeout)."""
+        self._draining = True
+        self.batcher.close()          # wakes the dispatcher
+        if not self._started:
+            return True
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        self._dispatcher.join(timeout)
+        if self._dispatcher.is_alive():
+            # the dispatcher may hold a just-popped batch it has not
+            # assigned yet — declaring the workers idle now would
+            # strand that batch on a stopped worker's queue forever
+            return False
+        with self._lock:
+            while any(w._queue or w.inflight_rows
+                      for w in self._workers):
+                wait = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if wait is not None and wait <= 0:
+                    return False
+                self._idle.wait(wait)
+            self._stopping = True
+            self._work_ready.notify_all()
+        for w in self._workers:
+            wait = None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+            w.thread.join(wait)
+        return all(not w.thread.is_alive() for w in self._workers)
+
+    stop = drain
+
+    @contextmanager
+    def handle_signals(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """Install handlers that request a graceful drain (the
+        PreemptionGuard shape: the handler only sets a flag; rejection
+        of new work and the drain itself happen on worker/caller
+        threads, never in signal context)."""
+        old = {}
+
+        def _handler(signum, frame):
+            # signal context: only set a flag (PreemptionGuard's rule) —
+            # the dispatcher thread notices it and closes the batcher;
+            # taking the batcher lock here could deadlock against the
+            # interrupted main-thread frame
+            self._drain_requested = True
+
+        try:
+            for sig in signals:
+                try:
+                    old[sig] = signal.signal(sig, _handler)
+                except ValueError:   # not the main thread
+                    pass
+            yield self
+        finally:
+            for sig, prev in old.items():
+                signal.signal(sig, prev)
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def submit(self, inputs, deadline=None):
+        if not self._started:
+            raise MXNetError("ModelServer.submit before start()")
+        if self.draining:
+            raise ServerClosed("server is draining; request refused")
+        return self.batcher.submit(inputs, deadline=deadline)
+
+    def infer(self, inputs, deadline=None, timeout=None):
+        """Synchronous convenience: submit + block for the result."""
+        return self.submit(inputs, deadline=deadline).result(timeout)
+
+    # ------------------------------------------------------------------
+    # dispatch + compute
+    # ------------------------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            if self._drain_requested and not self.batcher.closed:
+                self.batcher.close()     # finish queued, reject new
+            # backpressure: don't pop from the BOUNDED batcher queue
+            # until some worker has a free backlog slot (at most one
+            # queued batch per worker) — draining into unbounded worker
+            # lists would keep the batcher near-empty and defeat the
+            # queue_depth/shedding contract under sustained overload
+            with self._lock:
+                while all(w._queue for w in self._workers):
+                    self._slot_free.wait(0.1)
+            batch = self.batcher.next_batch(timeout=0.1)
+            if batch is None:
+                if self.batcher.closed:
+                    return
+                continue
+            rows = sum(r.n for r in batch)
+            with self._lock:
+                free = [w for w in self._workers if not w._queue]
+                worker = min(free or self._workers,
+                             key=lambda w: w.inflight_rows)
+                worker.inflight_rows += rows
+                worker._queue.append(batch)
+                self._work_ready.notify_all()
+
+    def _run_batch(self, worker, batch):
+        t0 = time.perf_counter()
+        # a deadline can run out between batcher dequeue and this
+        # worker reaching the batch — re-check so doomed requests are
+        # rejected (never computed), same contract as queue-time expiry
+        batch = self.batcher.reject_expired(batch)
+        if not batch:
+            return
+        rows = sum(r.n for r in batch)
+        try:
+            chaos_point("serving.infer")
+            stacked = {
+                name: (batch[0].inputs[name] if len(batch) == 1
+                       else np.concatenate(
+                           [r.inputs[name] for r in batch], axis=0))
+                for name in self.engine.data_names}
+            outs = self.engine.infer(stacked, n=rows,
+                                     device=worker.device)
+            # responses are HOST arrays: one device sync per output per
+            # batch, then zero-copy numpy views per request — a jax
+            # slice op per request would hand back the very dispatch
+            # overhead the coalescing just amortized away
+            host = [o.asnumpy() for o in outs]
+        except Exception as err:   # noqa: BLE001 — delivered per request
+            for req in batch:
+                req.reject(err)
+            _REQS_FAILED.inc(len(batch))
+            return
+        offset = 0
+        for req in batch:
+            req.resolve([o[offset:offset + req.n] for o in host])
+            offset += req.n
+        worker.served_requests += len(batch)
+        worker.served_batches += 1
+        _REQS_SERVED.inc(len(batch))
+        dt = time.perf_counter() - t0
+        _BATCH_SECONDS.observe(dt)
+        if _telemetry.stream_enabled():
+            with self._lock:
+                step = self._step
+                self._step += 1
+            _telemetry.emit({
+                "ts": time.time(), "source": "serving", "step": step,
+                "step_time": dt, "batch_size": rows,
+                "requests": len(batch),
+                "fill_ratio": rows / float(self.batcher.max_batch_size),
+                "queue_depth": len(self.batcher),
+                "shed_total": self.batcher.shed,
+                "worker": worker.index,
+            })
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Point-in-time snapshot for monitoring/debug endpoints."""
+        with self._lock:
+            workers = [{
+                "index": w.index, "device": str(w.device),
+                "inflight_rows": w.inflight_rows,
+                "served_requests": w.served_requests,
+                "served_batches": w.served_batches,
+            } for w in self._workers]
+        # this server's own labelset — two servers in one process must
+        # not report each other's tails
+        lat = _obs.REGISTRY.get("serving.request.latency")
+        labels = {"server": self.batcher.name}
+        return {
+            "engine": self.engine.name,
+            "buckets": list(self.engine.buckets),
+            "compiled_buckets": self.engine.compiled_buckets,
+            "max_batch_size": self.batcher.max_batch_size,
+            "max_wait_ms": self.batcher.max_wait_s * 1000.0,
+            "queue_depth": len(self.batcher),
+            "queue_limit": self.batcher.queue_depth,
+            "shed_policy": self.batcher.shed_policy,
+            "submitted": self.batcher.submitted,
+            "shed": self.batcher.shed,
+            "served": sum(w["served_requests"] for w in workers),
+            "batches": sum(w["served_batches"] for w in workers),
+            "draining": self.draining,
+            "request_latency_p50_s": lat.percentile(0.50, **labels),
+            "request_latency_p95_s": lat.percentile(0.95, **labels),
+            "workers": workers,
+        }

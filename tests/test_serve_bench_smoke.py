@@ -1,0 +1,68 @@
+"""tools/serve_bench.py must stay runnable: the driver checks its
+closed-loop record (>= 3x serial at output parity) on real hardware, so
+a tiny-shape CPU smoke run gates bitrot — same contract as
+tests/test_bench_smoke.py for bench.py."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(extra_env=None, args=()):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               MXTPU_SERVE_BENCH_CLIENTS="8",
+               MXTPU_SERVE_BENCH_REQUESTS="96",
+               MXTPU_SERVE_BENCH_SERIAL="48",
+               MXTPU_SERVE_BENCH_FEATURES="64",
+               MXTPU_SERVE_BENCH_HIDDEN="64")
+    env.update(extra_env or {})
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serve_bench.py"),
+         *args],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_serve_bench_smoke_closed_loop():
+    out = _run()
+    assert out["metric"] == "serving_closed_loop_throughput"
+    assert out["unit"] == "req/s" and out["value"] > 0
+    assert out["platform"] == "cpu"
+    extra = out["extra"]
+    # equal output parity between the serial Predictor and the batched
+    # server is a hard requirement, whatever the speedup
+    assert extra["parity"] is True
+    assert extra["serial_rps"] > 0
+    assert extra["errors"] == 0
+    assert "speedup_vs_serial" in extra
+    for key in ("latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+                "shed_rate", "batches"):
+        assert key in extra, extra
+
+
+def test_serve_bench_smoke_open_loop():
+    out = _run(args=("--mode", "open", "--rate", "500"))
+    assert out["metric"] == "serving_open_loop_throughput"
+    ol = out["extra"]["open_loop"]
+    assert ol["completed"] + ol["shed"] + ol["failed"] == ol["requests"]
+    assert out["extra"]["parity"] is True
+
+
+@pytest.mark.slow
+def test_serve_bench_meets_3x_acceptance():
+    """ISSUE-5 acceptance: closed-loop batched throughput >= 3x the
+    serial per-request Predictor loop on CPU (full-size run; excluded
+    from tier-1 where CI load makes throughput ratios flaky)."""
+    out = _run(extra_env={"MXTPU_SERVE_BENCH_CLIENTS": "16",
+                          "MXTPU_SERVE_BENCH_REQUESTS": "640",
+                          "MXTPU_SERVE_BENCH_SERIAL": "200",
+                          "MXTPU_SERVE_BENCH_FEATURES": "256",
+                          "MXTPU_SERVE_BENCH_HIDDEN": "256"})
+    assert out["extra"]["parity"] is True
+    assert out["extra"]["speedup_vs_serial"] >= 3.0, out["extra"]

@@ -1,0 +1,278 @@
+"""Serving load generator: batched ModelServer vs. serial Predictor.
+
+    python tools/serve_bench.py                 # closed loop (default)
+    python tools/serve_bench.py --mode open
+    python tools/serve_bench.py --mode both
+
+Two load models against the same frozen MLP:
+
+- **closed loop**: N client threads, each submitting its next request
+  the moment the previous one resolves — the saturating-traffic model.
+  Throughput here shows the dispatch-amortization win of dynamic
+  batching (ISSUE acceptance: >= 3x the serial per-request Predictor
+  loop on CPU, at equal output parity).
+- **open loop**: requests offered at a fixed rate regardless of
+  completions — the overload model. Shed rate and tail latency show
+  the load-shedding policy doing its job instead of the queue growing
+  without bound.
+
+The last stdout line is one JSON record (same contract as bench.py:
+it must exist and parse everywhere, and its `platform` field says what
+the numbers were measured on):
+
+    {"metric": "serving_closed_loop_throughput", "value": ..,
+     "unit": "req/s", "platform": "cpu",
+     "extra": {"serial_rps": .., "speedup_vs_serial": ..,
+               "latency_p50_ms": .., "latency_p95_ms": ..,
+               "latency_p99_ms": .., "shed_rate": .., "parity": true}}
+
+Env knobs (flags win): MXTPU_SERVE_BENCH_CLIENTS (16),
+MXTPU_SERVE_BENCH_REQUESTS (640 total), MXTPU_SERVE_BENCH_SERIAL (160),
+MXTPU_SERVE_BENCH_FEATURES (256), MXTPU_SERVE_BENCH_HIDDEN (256),
+MXTPU_SERVE_BENCH_RATE (open-loop offered req/s, 2000),
+MXTPU_SERVE_BENCH_QUEUE (open-loop queue depth, 64).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def _build_model(features, hidden, classes=16, seed=7):
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data=data, num_hidden=hidden, name="fc1")
+    h = mx.sym.Activation(data=h, act_type="relu")
+    h = mx.sym.FullyConnected(data=h, num_hidden=hidden, name="fc2")
+    h = mx.sym.Activation(data=h, act_type="relu")
+    h = mx.sym.FullyConnected(data=h, num_hidden=classes, name="fc3")
+    sym = mx.sym.SoftmaxOutput(data=h, name="softmax")
+    rng = np.random.RandomState(seed)
+
+    def p(*shape):
+        return mx.nd.array((rng.randn(*shape) * 0.1).astype(np.float32))
+
+    args = {"fc1_weight": p(hidden, features), "fc1_bias": p(hidden),
+            "fc2_weight": p(hidden, hidden), "fc2_bias": p(hidden),
+            "fc3_weight": p(classes, hidden), "fc3_bias": p(classes)}
+    return sym, args
+
+
+def _percentile_ms(latencies, q):
+    if not latencies:
+        return 0.0
+    latencies = sorted(latencies)
+    rank = min(len(latencies) - 1, max(0, int(q * len(latencies)) - 1))
+    return latencies[rank] * 1000.0
+
+
+def run_serial(sym, args, features, n_requests, xs):
+    """The pre-serving deployment story: one Predictor, one request per
+    forward(), one XLA dispatch each — the baseline dynamic batching
+    has to beat."""
+    from mxnet_tpu.c_predict import Predictor
+    # the label head needs a declared shape on this API (it always
+    # did); it stays zero — predict mode never reads it
+    pred = Predictor(sym, args, {}, {"data": (1, features),
+                                     "softmax_label": (1,)})
+    buf = xs[0:1].tobytes()
+    pred.set_input("data", buf)
+    np.asarray(pred.forward()[0].asnumpy())      # warm the program
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        pred.set_input("data", xs[i % len(xs)][None].tobytes())
+        out = pred.forward()
+        out[0].asnumpy()                          # block on the result
+    dt = time.perf_counter() - t0
+    return n_requests / dt, pred
+
+
+def run_closed(server, xs, clients, total_requests):
+    per_client = max(1, total_requests // clients)
+    latencies, errors = [], []
+    lock = threading.Lock()
+
+    def client(idx):
+        got = []
+        for i in range(per_client):
+            x = xs[(idx * per_client + i) % len(xs)][None]
+            t0 = time.perf_counter()
+            try:
+                h = server.submit(x)
+                h.result(timeout=60)
+            except Exception as err:  # noqa: BLE001 — recorded
+                with lock:
+                    errors.append(repr(err))
+                continue
+            got.append(time.perf_counter() - t0)
+        with lock:
+            latencies.extend(got)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = len(latencies)
+    return {
+        "requests": done, "errors": len(errors), "wall_s": wall,
+        "rps": done / wall if wall > 0 else 0.0,
+        "latency_p50_ms": _percentile_ms(latencies, 0.50),
+        "latency_p95_ms": _percentile_ms(latencies, 0.95),
+        "latency_p99_ms": _percentile_ms(latencies, 0.99),
+    }
+
+
+def run_open(server, xs, rate, total_requests):
+    """Offered-rate load: submit on a fixed schedule, never waiting for
+    completions; sheds and deadline misses are the interesting output."""
+    from mxnet_tpu.serving import RequestRejected
+    handles, shed = [], 0
+    interval = 1.0 / float(rate)
+    t0 = time.perf_counter()
+    for i in range(total_requests):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append((time.perf_counter(),
+                            server.submit(xs[i % len(xs)][None])))
+        except RequestRejected:
+            shed += 1
+    latencies, failed = [], 0
+    for t_sub, h in handles:
+        try:
+            h.result(timeout=60)
+            # resolved_at is stamped by the worker at completion, so
+            # the latency is submit -> resolve, not submit -> whenever
+            # this collection loop happens to visit the handle
+            latencies.append(h.resolved_at - t_sub)
+        except Exception:  # noqa: BLE001 — counted
+            failed += 1
+    wall = time.perf_counter() - t0
+    return {
+        "offered_rps": rate, "requests": total_requests,
+        "completed": len(latencies), "shed": shed, "failed": failed,
+        "shed_rate": shed / float(total_requests),
+        "wall_s": wall,
+        "rps": len(latencies) / wall if wall > 0 else 0.0,
+        "latency_p50_ms": _percentile_ms(latencies, 0.50),
+        "latency_p95_ms": _percentile_ms(latencies, 0.95),
+        "latency_p99_ms": _percentile_ms(latencies, 0.99),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="serving load generator (closed/open loop)")
+    parser.add_argument("--mode", choices=("closed", "open", "both"),
+                        default="closed")
+    parser.add_argument("--clients", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_CLIENTS", 16))
+    parser.add_argument("--requests", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_REQUESTS", 640))
+    parser.add_argument("--serial-requests", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_SERIAL", 160))
+    parser.add_argument("--features", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_FEATURES", 256))
+    parser.add_argument("--hidden", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_HIDDEN", 256))
+    parser.add_argument("--rate", type=float,
+                        default=_env_int("MXTPU_SERVE_BENCH_RATE", 2000))
+    parser.add_argument("--open-queue", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_QUEUE", 64))
+    args_ns = parser.parse_args(argv)
+
+    import jax
+    from mxnet_tpu.serving import InferenceEngine, ModelServer
+
+    sym, params = _build_model(args_ns.features, args_ns.hidden)
+    rng = np.random.RandomState(11)
+    xs = rng.randn(256, args_ns.features).astype(np.float32)
+
+    serial_rps, predictor = run_serial(sym, params, args_ns.features,
+                                       args_ns.serial_requests, xs)
+
+    # the engine's max batch == the client count, so a full closed-loop
+    # wave coalesces into exactly one dispatch and never waits out the
+    # coalescing window
+    max_batch = max(2, args_ns.clients)
+    engine = InferenceEngine.from_symbol(
+        sym, params, {}, {"data": (args_ns.features,)},
+        max_batch_size=max_batch, name="serve_bench")
+    extra = {"serial_rps": round(serial_rps, 2),
+             "clients": args_ns.clients, "max_batch": max_batch,
+             "features": args_ns.features, "hidden": args_ns.hidden}
+
+    # output parity: the same request through both deployment paths
+    predictor.set_input("data", xs[0:1].tobytes())
+    serial_out = predictor.forward()[0].asnumpy()
+
+    closed = None
+    if args_ns.mode in ("closed", "both"):
+        with ModelServer(engine, max_wait_ms=2.0, warmup=True) as server:
+            batched_out = np.asarray(server.infer(xs[0:1],
+                                                  timeout=60)[0])
+            extra["parity"] = bool(
+                np.array_equal(serial_out, batched_out))
+            closed = run_closed(server, xs, args_ns.clients,
+                                args_ns.requests)
+            stats = server.stats()
+        extra.update({
+            "latency_p50_ms": round(closed["latency_p50_ms"], 3),
+            "latency_p95_ms": round(closed["latency_p95_ms"], 3),
+            "latency_p99_ms": round(closed["latency_p99_ms"], 3),
+            "errors": closed["errors"],
+            "batches": stats["batches"],
+            "mean_batch_rows": round(
+                closed["requests"] / max(1, stats["batches"]), 2),
+            "shed_rate": stats["shed"] / max(1, stats["submitted"]),
+            "speedup_vs_serial": round(
+                closed["rps"] / serial_rps, 3) if serial_rps else 0.0,
+        })
+
+    if args_ns.mode in ("open", "both"):
+        open_engine = engine
+        with ModelServer(open_engine, max_wait_ms=2.0,
+                         queue_depth=args_ns.open_queue,
+                         warmup=True) as server:
+            if "parity" not in extra:
+                batched_out = np.asarray(server.infer(
+                    xs[0:1], timeout=60)[0])
+                extra["parity"] = bool(
+                    np.array_equal(serial_out, batched_out))
+            extra["open_loop"] = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in run_open(server, xs, args_ns.rate,
+                                     args_ns.requests).items()}
+
+    headline = closed if closed is not None \
+        else {"rps": extra["open_loop"]["rps"]}
+    print(json.dumps({
+        "metric": "serving_closed_loop_throughput"
+                  if closed is not None
+                  else "serving_open_loop_throughput",
+        "value": round(headline["rps"], 2), "unit": "req/s",
+        "platform": jax.default_backend(),
+        "extra": extra}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

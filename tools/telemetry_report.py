@@ -65,17 +65,25 @@ def load_records(path):
 
 
 def summarize(records):
-    step_times = sorted(float(r["step_time"]) for r in records)
+    # serving batch records ride the same stream (source="serving");
+    # they describe ~ms service times, not training steps, and would
+    # turn the headline percentiles and samples/sec into a meaningless
+    # blend — the serving section below covers them, the headline
+    # covers everything else (a serving-only file keeps its records)
+    core = [r for r in records
+            if not str(r.get("source", "")).startswith("serving")] \
+        or records
+    step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
-    total_samples = sum(int(r.get("batch_size", 0)) for r in records)
+    total_samples = sum(int(r.get("batch_size", 0)) for r in core)
     summary = {
-        "steps": len(records),
+        "steps": len(core),
         "sources": sorted({r.get("source", "?") for r in records}),
         "total_time_s": total_time,
         "step_time_p50_s": _percentile(step_times, 0.50),
         "step_time_p95_s": _percentile(step_times, 0.95),
         "step_time_p99_s": _percentile(step_times, 0.99),
-        "step_time_mean_s": total_time / len(records),
+        "step_time_mean_s": total_time / len(core),
         "data_wait_s": sum(float(r.get("data_wait", 0)) for r in records),
         "compile_count": sum(int(r.get("compile_count", 0))
                              for r in records),
@@ -128,6 +136,29 @@ def summarize(records):
         if opt_times:
             summary["optimizer_p50_s"] = _percentile(opt_times, 0.50)
             summary["optimizer_p95_s"] = _percentile(opt_times, 0.95)
+    # serving section (docs/serving.md): per-batch records ModelServer
+    # workers emit with source="serving" — step_time is the batch's
+    # service time, shed_total the batcher's cumulative shed counter
+    serving = [r for r in records
+               if str(r.get("source", "")).startswith("serving")]
+    if serving:
+        svc = sorted(float(r["step_time"]) for r in serving)
+        reqs = sum(int(r.get("requests", 0)) for r in serving)
+        rows = sum(int(r.get("batch_size", 0)) for r in serving)
+        fills = [float(r["fill_ratio"]) for r in serving
+                 if "fill_ratio" in r]
+        summary["serving_batches"] = len(serving)
+        summary["serving_requests"] = reqs
+        summary["serving_rows"] = rows
+        summary["serving_batch_p50_s"] = _percentile(svc, 0.50)
+        summary["serving_batch_p95_s"] = _percentile(svc, 0.95)
+        summary["serving_batch_p99_s"] = _percentile(svc, 0.99)
+        if fills:
+            summary["serving_fill_mean"] = sum(fills) / len(fills)
+        summary["serving_queue_depth_max"] = max(
+            int(r.get("queue_depth", 0)) for r in serving)
+        summary["serving_shed"] = max(
+            int(r.get("shed_total", 0)) for r in serving)
     return summary
 
 
@@ -181,6 +212,18 @@ def format_summary(s):
             lines.append(
                 "              update phase p50 %.4fs  p95 %.4fs"
                 % (s["optimizer_p50_s"], s["optimizer_p95_s"]))
+    if "serving_batches" in s:
+        lines.append(
+            "  serving     %d batches  %d requests (%d rows)  "
+            "fill %.0f%%  shed %d"
+            % (s["serving_batches"], s["serving_requests"],
+               s["serving_rows"], 100.0 * s.get("serving_fill_mean", 0),
+               s["serving_shed"]))
+        lines.append(
+            "              batch p50 %.4fs  p95 %.4fs  p99 %.4fs  "
+            "queue max %d"
+            % (s["serving_batch_p50_s"], s["serving_batch_p95_s"],
+               s["serving_batch_p99_s"], s["serving_queue_depth_max"]))
     return "\n".join(lines)
 
 
