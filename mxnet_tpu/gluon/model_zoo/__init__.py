@@ -2,5 +2,7 @@
 (reference: python/mxnet/gluon/model_zoo/)."""
 from . import model_store
 from . import vision
+from . import gpt
 
 from .vision import get_model
+from .gpt import GPTDecoder, get_gpt

@@ -13,9 +13,9 @@ collectives over a named `jax.sharding.Mesh`:
 - expert parallel:  `moe_ffn` (top-k routed experts, all_to_all dispatch)
 - multi-host:       `DistKVStore` ('tpu_dist') over jax.distributed
 """
-from .mesh import (make_mesh, data_parallel_mesh, replicated, shard_on,
-                   put_sharded, use_mesh, current_mesh, Mesh,
-                   NamedSharding, PartitionSpec)
+from .mesh import (make_mesh, data_parallel_mesh, replica_devices,
+                   replicated, shard_on, put_sharded, use_mesh,
+                   current_mesh, Mesh, NamedSharding, PartitionSpec)
 from .data_parallel import ShardedTrainer
 from .ring_attention import ring_attention, local_attention, RingAttention
 from .pipeline import pipeline_apply
@@ -26,7 +26,8 @@ from .kvstore_dist import DistKVStore, init_distributed
 from . import checkpoint  # sharded/async TrainerCheckpoint (orbax)
 from .prefetch import DevicePrefetcher, stage_databatch
 
-__all__ = ["make_mesh", "data_parallel_mesh", "replicated", "shard_on",
+__all__ = ["make_mesh", "data_parallel_mesh", "replica_devices",
+           "replicated", "shard_on",
            "put_sharded", "use_mesh", "current_mesh", "Mesh",
            "NamedSharding", "PartitionSpec", "ShardedTrainer",
            "ring_attention", "local_attention", "RingAttention",

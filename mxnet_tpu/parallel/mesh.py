@@ -83,6 +83,19 @@ def data_parallel_mesh(n=None):
     return make_mesh({"dp": len(devices)}, devices)
 
 
+def replica_devices(n=None):
+    """The local device enumeration serving replicas bind to — the
+    same list `make_mesh` lays meshes over, so a host that trains on a
+    mesh serves one engine/scheduler replica per mesh device. `n` caps
+    the list (a serving process that wants fewer replicas than chips);
+    it never cycles — replicas beyond the device count would just
+    timeshare and defeat the placement."""
+    devices = jax.local_devices()
+    if n is not None:
+        devices = devices[:max(1, int(n))]
+    return devices
+
+
 def replicated(mesh):
     """Sharding that replicates across the whole mesh."""
     return NamedSharding(mesh, PartitionSpec())

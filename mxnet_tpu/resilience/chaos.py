@@ -21,9 +21,11 @@ MXTPU_CHAOS_SEED (default 0) and the site name, so a chaos run replays
 bit-identically across processes and reruns.
 
 Injection sites wired through the runtime: `kvstore.push`, `dist.init`,
-`checkpoint.save`, `io.read`, `engine.host_push`, `serving.infer`. A
-`chaos_point(site)` call is free when no spec is configured (one dict
-lookup).
+`checkpoint.save`, `io.read`, `engine.host_push`, `serving.infer`,
+`serving.decode` (fires before every continuous-batching decode step;
+kind=sleep stretches steps so deadline eviction can be exercised,
+kind=raise fails every in-flight sequence). A `chaos_point(site)` call
+is free when no spec is configured (one dict lookup).
 """
 from __future__ import annotations
 

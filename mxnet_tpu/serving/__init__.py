@@ -8,7 +8,8 @@ a trainer. Three pillars:
              symbol+params frozen into ONE donated forward-only
              `jax.jit`, with padding-bucket batch shapes (powers of two
              up to `max_batch_size`) so arbitrary request sizes hit a
-             bounded compile cache, plus `warmup()` precompilation.
+             bounded compile cache, plus `warmup()` precompilation and
+             a `dtype="bf16"` serving mode (``MXTPU_SERVE_DTYPE``).
 - `batcher`: `DynamicBatcher` — thread-safe bounded queue coalescing
              requests up to `max_batch_size` rows or `max_wait_ms`,
              deadline-aware (`resilience.Deadline`; expired requests
@@ -19,16 +20,35 @@ a trainer. Three pillars:
              in-flight, reject new — the `PreemptionGuard` shape), and
              a `stats()` snapshot.
 
+Generation is the second engine kind (ISSUE-6):
+
+- `decode`:    `DecodeEngine` — an autoregressive block frozen into a
+               padded-bucket prefill plus ONE donated single-token
+               decode step over a statically-shaped slot KV cache
+               (exactly two decode-path programs, prefill buckets
+               aside). `dtype="bf16"` serves in bfloat16.
+- `scheduler`: `ContinuousBatchScheduler` — Orca-style continuous
+               batching: sequences join free cache slots and retire
+               *between* decode steps, deadlines evict at step
+               boundaries, the step shape never changes.
+
+`ModelServer` serves either kind (per-device replicas, least-loaded
+dispatch, graceful drain).
+
 `c_predict.Predictor` and `Module.predict` are thin shims over this
 layer (``MXTPU_SERVING_ENGINE=0`` restores the legacy Module path).
-Chaos site: `serving.infer`. Metrics: `serving.*` in the observability
-registry; per-batch JSONL records ride the ``MXTPU_TELEMETRY`` stream.
+Chaos sites: `serving.infer`, `serving.decode`. Metrics: `serving.*`
+in the observability registry; per-batch/per-step JSONL records ride
+the ``MXTPU_TELEMETRY`` stream.
 """
-from .engine import InferenceEngine, bucket_sizes
+from .engine import InferenceEngine, bucket_sizes, resolve_serve_dtype
 from .batcher import (DynamicBatcher, InferenceRequest, RequestRejected,
                       ServerClosed)
+from .decode import DecodeEngine
+from .scheduler import ContinuousBatchScheduler, DecodeRequest
 from .server import ModelServer
 
-__all__ = ["InferenceEngine", "bucket_sizes", "DynamicBatcher",
-           "InferenceRequest", "RequestRejected", "ServerClosed",
-           "ModelServer"]
+__all__ = ["InferenceEngine", "bucket_sizes", "resolve_serve_dtype",
+           "DynamicBatcher", "InferenceRequest", "RequestRejected",
+           "ServerClosed", "DecodeEngine", "ContinuousBatchScheduler",
+           "DecodeRequest", "ModelServer"]

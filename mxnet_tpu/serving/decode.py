@@ -1,0 +1,312 @@
+"""DecodeEngine: KV-cached autoregressive generation as two programs.
+
+The full-program-compilation lesson (PAPERS.md arXiv:1810.09868) applied
+to generation: a serving process should run a SMALL, FIXED set of XLA
+programs, however long the sequences or however requests come and go.
+An autoregressive block (anything exposing the decode protocol below —
+`gluon.model_zoo.GPTDecoder` is the in-repo model) is frozen into:
+
+- **prefill** (per padding bucket): full causal forward over a prompt
+  padded up to a power-of-two length (PR 5's `bucket_sizes` ladder, so
+  ≤ log2(max_seq_len)+1 programs), returning the first greedy token and
+  the prompt's K/V zero-masked and padded out to `max_seq_len`;
+- **admit** (one program): writes a prefilled K/V sequence into a free
+  slot of the engine's statically-shaped cache — the slot index is a
+  traced scalar, so every slot shares the compile;
+- **step** (one program): ONE token for EVERY slot, `jax.jit` with
+  `donate_argnums` on the KV cache and the position vector — the
+  at-rest state buffers alias in place, nothing is re-allocated, and
+  because the decode batch shape is pinned at `max_slots` the program
+  never recompiles as sequences join and leave.
+
+Prefill buckets aside, the decode path therefore compiles exactly TWO
+programs (admit + step) — asserted by `compiled_programs` in tests.
+
+The cache is slot-based: (num_layers, max_slots, max_seq_len, heads,
+head_dim) for K and V, plus a (max_slots,) int32 position vector (rows
+of cache filled per slot). `ContinuousBatchScheduler` owns slot
+assignment; the engine only moves tensors.
+
+`dtype="bf16"` (or env ``MXTPU_SERVE_DTYPE=bf16``) casts params and the
+cache to bfloat16 at freeze time; logits come back to fp32 before the
+greedy argmax either way.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, getenv
+from ..observability import registry as _obs
+from .engine import bucket_sizes, resolve_serve_dtype
+
+__all__ = ["DecodeEngine"]
+
+_COMPILES = _obs.counter(
+    "serving.decode.compiles",
+    "decode-path XLA programs compiled, by kind "
+    "(prefill buckets, admit, step)")
+_STEP_SECONDS = _obs.histogram(
+    "serving.decode.step.seconds",
+    "wall time of one whole-batch decode step dispatch")
+_PREFILL_SECONDS = _obs.histogram(
+    "serving.decode.prefill.seconds",
+    "wall time of one prompt prefill (+ cache admit) dispatch")
+
+
+class DecodeEngine:
+    """A frozen autoregressive model plus its at-rest decode state.
+
+    `block` must expose the decode protocol:
+
+    - ``decode_spec()`` -> dict with at least ``max_seq_len``,
+      ``vocab_size`` and (optionally) ``eos_token``;
+    - ``decode_params(dtype=None)`` -> {name: jnp array};
+    - ``init_cache(slots, dtype=None)`` -> (k, v) zero caches shaped
+      (..., slots, max_seq_len, ...) with the slot axis second;
+    - ``prefill_fn()`` -> pure fn(params, tokens (1, Lb), length) ->
+      (next_token, k_seq, v_seq) with k/v padded to max_seq_len;
+    - ``step_fn()`` -> pure fn(params, cache_k, cache_v, positions,
+      active, tokens) -> (cache_k, cache_v, positions, next_tokens).
+
+    The engine is single-consumer: one scheduler (or caller thread)
+    drives prefill/admit/step; only introspection is thread-safe.
+    """
+
+    def __init__(self, block, max_slots=None, dtype=None, donate=None,
+                 device=None, name=None):
+        spec = getattr(block, "decode_spec", None)
+        if spec is None:
+            raise MXNetError(
+                "DecodeEngine wants a block with the decode protocol "
+                "(decode_spec/decode_params/init_cache/prefill_fn/"
+                "step_fn) — gluon.model_zoo.GPTDecoder is the in-repo "
+                "reference; got %s" % type(block).__name__)
+        self._block = block
+        self._spec = dict(spec())
+        self.name = name or getattr(block, "name", None) or "decode"
+        self.dtype = resolve_serve_dtype(dtype)
+        self.max_seq_len = int(self._spec["max_seq_len"])
+        self.max_slots = int(max_slots if max_slots is not None
+                             else getenv("MXTPU_DECODE_SLOTS", 8))
+        if self.max_slots < 1:
+            raise MXNetError("max_slots must be >= 1, got %d"
+                             % self.max_slots)
+        self.eos_token = self._spec.get("eos_token")
+        self.device = device
+        self._buckets = bucket_sizes(self.max_seq_len)
+        if donate is None:
+            donate = getenv("MXTPU_SERVE_DONATE", True)
+        self._donate = bool(donate)
+
+        cast = self.dtype if self.dtype == "bf16" else None
+        params = block.decode_params(dtype=cast)
+        if device is not None:
+            params = {k: jax.device_put(v, device)
+                      for k, v in params.items()}
+        self._params = params
+
+        prefill = block.prefill_fn()
+        step = block.step_fn()
+
+        def admit(cache_k, cache_v, positions, k_seq, v_seq, slot,
+                  length):
+            # slot is a TRACED scalar: one compiled scatter program
+            # covers every slot index
+            cache_k = cache_k.at[:, slot].set(k_seq)
+            cache_v = cache_v.at[:, slot].set(v_seq)
+            positions = positions.at[slot].set(length)
+            return cache_k, cache_v, positions
+
+        self._prefill_jit = jax.jit(prefill)
+        donate_state = (0, 1, 2) if self._donate else ()
+        self._admit_jit = jax.jit(admit, donate_argnums=donate_state)
+        self._step_jit = jax.jit(
+            step, donate_argnums=tuple(1 + a for a in donate_state)
+            if self._donate else ())
+
+        self._lock = threading.Lock()
+        self._compiled = {}          # kind or ("prefill", bucket) -> 1
+        self.steps = 0
+        self.reset()
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+    def reset(self):
+        """(Re)allocate the cache and clear every slot."""
+        cache_k, cache_v = self._block.init_cache(
+            self.max_slots, dtype=self.dtype
+            if self.dtype == "bf16" else None)
+        positions = jnp.zeros((self.max_slots,), jnp.int32)
+        # COMMIT the state buffers to their device (default device when
+        # unpinned): the admit/step jits key on input shardings, and an
+        # uncommitted fresh cache next to committed jit outputs would
+        # silently compile each program twice
+        device = self.device if self.device is not None \
+            else jax.local_devices()[0]
+        self._cache_k = jax.device_put(cache_k, device)
+        self._cache_v = jax.device_put(cache_v, device)
+        self._positions = jax.device_put(positions, device)
+        # host mirrors — slot bookkeeping must not sync the device
+        self.positions = np.zeros((self.max_slots,), np.int64)
+        self.active = np.zeros((self.max_slots,), bool)
+        self.tokens = np.zeros((self.max_slots,), np.int64)
+
+    @property
+    def free_slots(self):
+        return [i for i in range(self.max_slots) if not self.active[i]]
+
+    @property
+    def active_slots(self):
+        return [i for i in range(self.max_slots) if self.active[i]]
+
+    @property
+    def compiled_programs(self):
+        """{kind: count} of decode-path programs this engine compiled:
+        'prefill' (one per padding bucket used), 'admit', 'step'. The
+        exactly-two invariant: admit + step == 2, always."""
+        with self._lock:
+            out = {}
+            for key in self._compiled:
+                kind = key[0] if isinstance(key, tuple) else key
+                out[kind] = out.get(kind, 0) + 1
+            return out
+
+    def xla_cache_sizes(self):
+        """{kind: number of XLA programs in that jit's cache} straight
+        from jax (catches silent retraces the logical counter can't —
+        e.g. a sharding mismatch compiling one function twice). The
+        exactly-two invariant holds here too: admit + step == 2."""
+        out = {}
+        for kind, jitted in (("prefill", self._prefill_jit),
+                             ("admit", self._admit_jit),
+                             ("step", self._step_jit)):
+            size = getattr(jitted, "_cache_size", None)
+            if size is not None:
+                out[kind] = size()
+        return out
+
+    def _count_compile(self, key):
+        with self._lock:
+            if key in self._compiled:
+                return
+            self._compiled[key] = 1
+        kind = key[0] if isinstance(key, tuple) else key
+        _COMPILES.inc(engine=self.name, kind=kind)
+
+    def bucket_for(self, n):
+        """Smallest prefill padding bucket holding an n-token prompt."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError("prompt must have >= 1 token")
+        if n > self.max_seq_len:
+            raise MXNetError(
+                "prompt of %d tokens exceeds max_seq_len=%d"
+                % (n, self.max_seq_len))
+        for b in self._buckets:
+            if b >= n:
+                return b
+        raise AssertionError("unreachable")
+
+    # ------------------------------------------------------------------
+    # the three programs
+    # ------------------------------------------------------------------
+    def prefill(self, tokens, slot):
+        """Prefill `tokens` (1-D int array) into free cache slot
+        `slot`: pads the prompt to its bucket, runs the bucketed
+        prefill program, admits the K/V into the cache (one fixed-shape
+        program for every slot/bucket), marks the slot active, and
+        returns the first greedy token (int)."""
+        tokens = np.asarray(tokens).reshape(-1)
+        n = tokens.shape[0]
+        bucket = self.bucket_for(n)
+        if self.active[slot]:
+            raise MXNetError("slot %d is already active" % slot)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        t0 = time.perf_counter()
+        args = (self._params, jnp.asarray(padded), jnp.int32(n))
+        if self.device is not None:
+            args = (self._params,
+                    jax.device_put(jnp.asarray(padded), self.device),
+                    jax.device_put(jnp.int32(n), self.device))
+        next_token, k_seq, v_seq = self._prefill_jit(*args)
+        self._count_compile(("prefill", bucket))
+        self._cache_k, self._cache_v, self._positions = self._admit_jit(
+            self._cache_k, self._cache_v, self._positions,
+            k_seq, v_seq, jnp.int32(slot), jnp.int32(n))
+        self._count_compile("admit")
+        first = int(next_token)
+        self.positions[slot] = n
+        self.active[slot] = True
+        self.tokens[slot] = first
+        _PREFILL_SECONDS.observe(time.perf_counter() - t0,
+                                 engine=self.name)
+        return first
+
+    def step(self):
+        """One decode step across ALL slots (the continuous-batching
+        invariant: fixed shape, every step). Returns np int array of
+        next tokens per slot — entries for inactive slots are noise and
+        must be ignored. Cache/positions advance in place (donated)."""
+        if not self.active.any():
+            raise MXNetError("step() with no active slots")
+        t0 = time.perf_counter()
+        tokens = jnp.asarray(self.tokens.astype(np.int32))
+        active = jnp.asarray(self.active)
+        if self.device is not None:
+            tokens = jax.device_put(tokens, self.device)
+            active = jax.device_put(active, self.device)
+        (self._cache_k, self._cache_v, self._positions,
+         next_tokens) = self._step_jit(
+            self._params, self._cache_k, self._cache_v,
+            self._positions, active, tokens)
+        self._count_compile("step")
+        out = np.asarray(next_tokens)
+        self.positions[self.active] += 1
+        self.tokens[self.active] = out[self.active]
+        self.steps += 1
+        _STEP_SECONDS.observe(time.perf_counter() - t0,
+                              engine=self.name)
+        return out
+
+    def retire(self, slot):
+        """Free a slot between steps (sequence finished or evicted).
+        Nothing touches the device: the slot's cache rows are dead and
+        the next admit overwrites them wholesale."""
+        self.active[slot] = False
+
+    def slot_full(self, slot):
+        """True when the slot's cache cannot hold another token (the
+        next step would have nowhere to write its K/V)."""
+        return self.positions[slot] >= self.max_seq_len
+
+    def fill_ratio(self):
+        return float(self.active.sum()) / float(self.max_slots)
+
+    def warmup(self, buckets=None):
+        """Precompile the step + admit programs and the given prefill
+        buckets (ALL of them by default, mirroring the forward
+        engine's contract: the first real prompt must never pay an XLA
+        compile inside the scheduling loop) with throwaway sequences
+        (slot state is reset)."""
+        if buckets is None:
+            buckets = self._buckets
+        for b in buckets:
+            self.prefill(np.zeros(min(int(b), self.max_seq_len),
+                                  np.int32), slot=self.free_slots[0])
+            self.step()
+            self.reset()
+
+    def replicate(self, device, name=None):
+        """A sibling engine (same block, fresh cache/programs) bound to
+        `device` — ModelServer's per-device decode replicas."""
+        return type(self)(self._block, max_slots=self.max_slots,
+                          dtype=self.dtype, donate=self._donate,
+                          device=device,
+                          name=name or "%s@%s" % (self.name, device))

@@ -35,7 +35,7 @@ from ..graph import build_graph_fn, collect_vars, infer_structs
 from ..ndarray import NDArray
 from ..observability import registry as _obs
 
-__all__ = ["InferenceEngine", "bucket_sizes"]
+__all__ = ["InferenceEngine", "bucket_sizes", "resolve_serve_dtype"]
 
 _COMPILES = _obs.counter(
     "serving.engine.compiles",
@@ -43,6 +43,31 @@ _COMPILES = _obs.counter(
 _INFER_SECONDS = _obs.histogram(
     "serving.engine.infer.seconds",
     "wall time of one InferenceEngine dispatch (pad + compute + wrap)")
+
+
+def resolve_serve_dtype(dtype):
+    """Normalize a serving dtype spec ('bf16'/'fp32'/None + env
+    ``MXTPU_SERVE_DTYPE``) to 'bf16' or 'fp32'. bf16 engines cast
+    float params AND float activations at freeze time (ROADMAP 2d:
+    cheap inference dtypes); outputs come back as float32."""
+    if dtype is None:
+        dtype = getenv("MXTPU_SERVE_DTYPE", "fp32")
+    dtype = str(dtype).lower()
+    if dtype in ("bf16", "bfloat16"):
+        return "bf16"
+    if dtype in ("fp32", "float32", "f32"):
+        return "fp32"
+    raise MXNetError("serve dtype must be 'fp32' or 'bf16', got %r"
+                     % (dtype,))
+
+
+def _serve_cast(dt, serve_dtype):
+    """The freeze-time dtype for a float leaf under the serving dtype
+    (non-floats — int tokens, bool masks — pass through)."""
+    if serve_dtype == "bf16" and np.dtype(dt) in (np.float32,
+                                                  np.float64):
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(dt)
 
 
 def bucket_sizes(max_batch_size):
@@ -73,17 +98,21 @@ class InferenceEngine:
 
     def __init__(self, symbol, arg_params, aux_params, data_descs,
                  max_batch_size, name=None, donate=None,
-                 static_shapes=None):
+                 static_shapes=None, dtype=None):
         # data_descs: [(input_name, per_example_shape, dtype)] — shapes
         # WITHOUT the leading batch dimension (it varies per bucket).
         # static_shapes: {name: FULL fixed shape} — inputs fed verbatim
         # with no padding/slicing (the c_predict contract: independent
         # fixed-shape buffers, scalars allowed)
+        # dtype: 'fp32' (default) or 'bf16' (MXTPU_SERVE_DTYPE) — bf16
+        # casts float params and float input descs at freeze time;
+        # float outputs are cast back to fp32 inside the jit
         self._symbol = symbol
         self.name = name or (symbol.name or "model")
+        self.dtype = resolve_serve_dtype(dtype)
         self.max_batch_size = int(max_batch_size)
         self._buckets = bucket_sizes(self.max_batch_size)
-        self._descs = [(str(n), tuple(s), np.dtype(dt))
+        self._descs = [(str(n), tuple(s), _serve_cast(dt, self.dtype))
                        for n, s, dt in data_descs]
         self._static = {str(n): tuple(s)
                         for n, s in (static_shapes or {}).items()}
@@ -114,6 +143,8 @@ class InferenceEngine:
                                and n not in arg_params]
         self._phantoms = {}          # bucket -> {name: zeros}
 
+        serve_dtype = self.dtype
+
         def take(src, names, kind):
             out = {}
             for n in names:
@@ -121,15 +152,18 @@ class InferenceEngine:
                     raise MXNetError(
                         "InferenceEngine: missing %s %r" % (kind, n))
                 v = src[n]
-                out[n] = v._data if isinstance(v, NDArray) \
+                v = v._data if isinstance(v, NDArray) \
                     else jnp.asarray(v)
+                cast = _serve_cast(v.dtype, serve_dtype)
+                out[n] = v if cast == v.dtype else v.astype(cast)
             return out
 
         self._params = take(arg_params, self._param_names, "parameter")
         self._aux = take(aux_params or {}, aux_names, "aux state")
         self._static_descs = {
-            n: (shape, np.dtype(arg_params[n].dtype
-                                if n in arg_params else np.float32))
+            n: (shape, _serve_cast(arg_params[n].dtype
+                                   if n in arg_params else np.float32,
+                                   serve_dtype))
             for n, shape in self._static.items()}
 
         fn, _, _, needs_rng = build_graph_fn(symbol._entries,
@@ -138,6 +172,12 @@ class InferenceEngine:
 
         def fwd(data, params, aux, key):
             outs, _ = fn({**data, **params}, aux, key)
+            if serve_dtype == "bf16":
+                # responses stay numpy-friendly fp32 whatever the
+                # compute dtype (the cast fuses into the program)
+                outs = [o.astype(jnp.float32)
+                        if o.dtype == jnp.bfloat16 else o
+                        for o in outs]
             return outs
 
         # the request batch is step-local by construction (`_pad` always
@@ -157,7 +197,7 @@ class InferenceEngine:
     @classmethod
     def from_symbol(cls, symbol, arg_params, aux_params, input_shapes,
                     max_batch_size, input_dtypes=None, name=None,
-                    donate=None, static_shapes=None):
+                    donate=None, static_shapes=None, dtype=None):
         """Freeze a symbol + params (the `c_predict` load path).
 
         `input_shapes`: {name: per-example shape} (no batch dim).
@@ -175,11 +215,11 @@ class InferenceEngine:
             descs.append((n, tuple(shape), np.dtype(dt or np.float32)))
         return cls(symbol, arg_params, aux_params, descs,
                    max_batch_size, name=name, donate=donate,
-                   static_shapes=static_shapes)
+                   static_shapes=static_shapes, dtype=dtype)
 
     @classmethod
     def from_module(cls, module, max_batch_size=None, name=None,
-                    donate=None):
+                    donate=None, dtype=None):
         """Freeze a bound Module (its symbol, current params, and bound
         data shapes; `max_batch_size` defaults to the bound batch)."""
         if not (module.binded and module.params_initialized):
@@ -202,11 +242,11 @@ class InferenceEngine:
                           np.dtype(getattr(d, "dtype", np.float32))))
         return cls(module._symbol, arg_params, aux_params, descs,
                    max_batch_size or batch,
-                   name=name or "module", donate=donate)
+                   name=name or "module", donate=donate, dtype=dtype)
 
     @classmethod
     def from_block(cls, block, *example_inputs, max_batch_size=None,
-                   name=None, donate=None):
+                   name=None, donate=None, dtype=None):
         """Freeze a Gluon HybridBlock via its CachedOp trace.
 
         `example_inputs`: NDArrays with the serving per-example shapes
@@ -249,7 +289,8 @@ class InferenceEngine:
             descs.append((t.name, tuple(x.shape[1:]), x.dtype))
         return cls(graph, arg_params, aux_params, descs,
                    max_batch_size or batch,
-                   name=name or block.name or "block", donate=donate)
+                   name=name or block.name or "block", donate=donate,
+                   dtype=dtype)
 
     # ------------------------------------------------------------------
     # introspection
@@ -283,17 +324,21 @@ class InferenceEngine:
 
     def set_params(self, arg_params, aux_params=None):
         """Swap in new parameter values (same names/shapes — the jit
-        cache keys on shapes, so no recompiles)."""
+        cache keys on shapes, so no recompiles). New values go through
+        the same serve-dtype cast as freeze time: swapping fp32
+        weights into a bf16 engine must not silently retrace every
+        bucket as an uncounted fp32 program."""
+        def staged(v):
+            v = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            cast = _serve_cast(v.dtype, self.dtype)
+            return v if cast == v.dtype else v.astype(cast)
+
         for n in self._param_names:
             if arg_params and n in arg_params:
-                v = arg_params[n]
-                self._params[n] = v._data if isinstance(v, NDArray) \
-                    else jnp.asarray(v)
+                self._params[n] = staged(arg_params[n])
         for n in list(self._aux):
             if aux_params and n in aux_params:
-                v = aux_params[n]
-                self._aux[n] = v._data if isinstance(v, NDArray) \
-                    else jnp.asarray(v)
+                self._aux[n] = staged(aux_params[n])
         with self._lock:
             self._placed = {}     # per-device copies are now stale
 

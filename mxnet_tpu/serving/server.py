@@ -34,7 +34,9 @@ from ..observability import registry as _obs
 from ..observability import telemetry as _telemetry
 from ..resilience import chaos_point
 from .batcher import DynamicBatcher, ServerClosed
+from .decode import DecodeEngine
 from .engine import InferenceEngine
+from .scheduler import ContinuousBatchScheduler
 
 __all__ = ["ModelServer"]
 
@@ -48,9 +50,9 @@ _REQS_FAILED = _obs.counter("serving.requests.failed",
 
 def _local_devices():
     """Local device enumeration (the replica list `parallel.mesh`
-    builds meshes from)."""
-    import jax
-    return jax.local_devices()
+    builds meshes from — `replica_devices` is the shared source)."""
+    from ..parallel.mesh import replica_devices
+    return replica_devices()
 
 
 class _Worker:
@@ -104,10 +106,44 @@ class ModelServer:
 
     def __init__(self, engine, num_workers=None, max_batch_size=None,
                  max_wait_ms=None, queue_depth=None, shed_policy=None,
-                 warmup=False):
+                 warmup=False, max_new_tokens=None):
+        if isinstance(engine, DecodeEngine):
+            # second engine kind: continuous-batching autoregressive
+            # decode — one ContinuousBatchScheduler per device replica,
+            # least-loaded dispatch at submit time, graceful drain
+            # finishes in-flight sequences (docs/serving.md)
+            if max_batch_size is not None or max_wait_ms is not None:
+                raise MXNetError(
+                    "max_batch_size/max_wait_ms are coalescing knobs "
+                    "of the forward engine; a DecodeEngine batches by "
+                    "cache slots (max_slots) — they have no effect "
+                    "here")
+            self.kind = "decode"
+            self.engine = engine
+            devices = _local_devices()
+            if num_workers is None:
+                num_workers = getenv("MXTPU_SERVE_WORKERS",
+                                     len(devices))
+            num_workers = max(1, min(int(num_workers), len(devices)))
+            engines = [engine]
+            for i in range(1, num_workers):
+                engines.append(engine.replicate(devices[i]))
+            self._schedulers = [
+                ContinuousBatchScheduler(
+                    e, max_new_tokens=max_new_tokens,
+                    queue_depth=queue_depth, shed_policy=shed_policy,
+                    name="%s/%d" % (engine.name, i))
+                for i, e in enumerate(engines)]
+            self._started = False
+            self._draining = False
+            self._drain_requested = False
+            self._warmup = bool(warmup)
+            return
+        self.kind = "forward"
         if not isinstance(engine, InferenceEngine):
-            raise MXNetError("ModelServer wants an InferenceEngine; "
-                             "use InferenceEngine.from_* to freeze a "
+            raise MXNetError("ModelServer wants an InferenceEngine or "
+                             "a DecodeEngine; use the from_* / "
+                             "DecodeEngine constructors to freeze a "
                              "model first")
         self.engine = engine
         devices = _local_devices()
@@ -148,6 +184,22 @@ class ModelServer:
     def start(self):
         if self._started:
             return self
+        if self.kind == "decode":
+            if self._warmup:
+                for s in self._schedulers:
+                    s.engine.warmup()
+            self._started = True
+            for s in self._schedulers:
+                s.start()
+            # forward mode's dispatcher notices _drain_requested and
+            # closes the batcher; decode mode has no dispatcher, so a
+            # watcher thread plays that role: on the SIGTERM flag it
+            # closes every scheduler (finish in-flight, reject new)
+            self._signal_watcher = threading.Thread(
+                target=self._decode_signal_watch, daemon=True,
+                name="decode-signal-watch")
+            self._signal_watcher.start()
+            return self
         if self._warmup:
             # warm every replica device the workers dispatch on, not
             # just the default one
@@ -170,11 +222,36 @@ class ModelServer:
     def draining(self):
         return self._draining or self._drain_requested
 
+    def _decode_signal_watch(self):
+        """Poll the signal-context drain flag (decode mode only): the
+        handler may only set a flag, so this thread performs the
+        actual scheduler close — the PreemptionGuard split between
+        signal context and worker context."""
+        while not (self._drain_requested or self._draining):
+            time.sleep(0.05)
+        for s in self._schedulers:
+            s.close()
+
     def drain(self, timeout=None):
         """Graceful shutdown: reject new submits, FINISH everything
         already queued or in flight, then stop the threads. Returns
-        True when fully drained (False only on timeout)."""
+        True when fully drained (False only on timeout). In decode
+        mode "in flight" means SEQUENCES: every admitted or queued
+        prompt decodes to completion before the schedulers stop."""
         self._draining = True
+        if self.kind == "decode":
+            if not self._started:
+                for s in self._schedulers:
+                    s.close()
+                return True
+            deadline = None if timeout is None \
+                else time.perf_counter() + timeout
+            ok = True
+            for s in self._schedulers:
+                wait = None if deadline is None \
+                    else max(0.0, deadline - time.perf_counter())
+                ok = s.drain(wait) and ok
+            return ok
         self.batcher.close()          # wakes the dispatcher
         if not self._started:
             return True
@@ -233,16 +310,38 @@ class ModelServer:
     # ------------------------------------------------------------------
     # client API
     # ------------------------------------------------------------------
-    def submit(self, inputs, deadline=None):
+    def submit(self, inputs, deadline=None, **decode_kwargs):
+        """Forward mode: `inputs` is {name: (n, *example) array}. Decode
+        mode: `inputs` is one 1-D prompt of token ids (plus optional
+        `max_new_tokens=`/`eos_token=`), dispatched to the least-loaded
+        scheduler replica (fewest queued + in-flight sequences)."""
         if not self._started:
             raise MXNetError("ModelServer.submit before start()")
         if self.draining:
             raise ServerClosed("server is draining; request refused")
+        if self.kind == "decode":
+            sched = min(self._schedulers, key=lambda s: s.load())
+            return sched.submit(inputs, deadline=deadline,
+                                **decode_kwargs)
+        if decode_kwargs:
+            raise MXNetError("decode kwargs %s only apply to a "
+                             "DecodeEngine server"
+                             % sorted(decode_kwargs))
         return self.batcher.submit(inputs, deadline=deadline)
 
     def infer(self, inputs, deadline=None, timeout=None):
         """Synchronous convenience: submit + block for the result."""
         return self.submit(inputs, deadline=deadline).result(timeout)
+
+    def generate(self, tokens, max_new_tokens=None, deadline=None,
+                 eos_token=None, timeout=None):
+        """Decode-mode synchronous convenience: submit one prompt and
+        block for its generated tokens (np.int32 array)."""
+        if self.kind != "decode":
+            raise MXNetError("generate() needs a DecodeEngine server")
+        return self.submit(tokens, deadline=deadline,
+                           max_new_tokens=max_new_tokens,
+                           eos_token=eos_token).result(timeout)
 
     # ------------------------------------------------------------------
     # dispatch + compute
@@ -329,6 +428,23 @@ class ModelServer:
     # ------------------------------------------------------------------
     def stats(self):
         """Point-in-time snapshot for monitoring/debug endpoints."""
+        if self.kind == "decode":
+            per = [s.stats() for s in self._schedulers]
+            return {
+                "kind": "decode",
+                "engine": self.engine.name,
+                "dtype": self.engine.dtype,
+                "max_slots": self.engine.max_slots,
+                "max_seq_len": self.engine.max_seq_len,
+                "workers": per,
+                "submitted": sum(p["submitted"] for p in per),
+                "served": sum(p["served"] for p in per),
+                "shed": sum(p["shed"] for p in per),
+                "evicted": sum(p["evicted"] for p in per),
+                "tokens": sum(p["tokens"] for p in per),
+                "queued": sum(p["queued"] for p in per),
+                "draining": self.draining,
+            }
         with self._lock:
             workers = [{
                 "index": w.index, "device": str(w.device),

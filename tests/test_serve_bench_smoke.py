@@ -54,6 +54,48 @@ def test_serve_bench_smoke_open_loop():
     assert out["extra"]["parity"] is True
 
 
+def test_serve_bench_smoke_decode():
+    """--mode decode must stay runnable and token-parity-true: small
+    shapes, but the full continuous-batching pipeline (bucketed
+    prefill, admit, donated step, scheduler) and the sequential
+    baseline both execute."""
+    out = _run(extra_env={"MXTPU_SERVE_BENCH_DECODE_SEQS": "8",
+                          "MXTPU_SERVE_BENCH_DECODE_SLOTS": "4",
+                          "MXTPU_SERVE_BENCH_DECODE_NEW": "6",
+                          "MXTPU_SERVE_BENCH_DECODE_PROMPT": "8",
+                          "MXTPU_SERVE_BENCH_DECODE_EMBED": "16"},
+               args=("--mode", "decode"))
+    assert out["metric"] == "serving_decode_throughput"
+    assert out["unit"] == "tok/s" and out["value"] > 0
+    assert out["platform"] == "cpu"
+    extra = out["extra"]
+    # continuous batching and the sequential baseline must emit the
+    # same greedy tokens — the decode analogue of the parity contract
+    assert extra["parity"] is True
+    assert extra["sequential_tok_s"] > 0
+    assert extra["tokens"] == 8 * 6
+    # the exactly-two-programs invariant holds under bench load too
+    assert {k: v for k, v in extra["compiled_programs"].items()
+            if k != "prefill"} == {"admit": 1, "step": 1}
+    for key in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                "intertoken_p50_ms", "intertoken_p95_ms",
+                "intertoken_p99_ms", "eviction_rate",
+                "speedup_vs_sequential"):
+        assert key in extra, extra
+
+
+@pytest.mark.slow
+def test_serve_bench_decode_meets_2x_acceptance():
+    """ISSUE-6 acceptance: continuous-batching decode >= 2x the
+    sequential per-request-decode baseline in tokens/s on CPU, at
+    token parity (full-size run; excluded from tier-1 where CI load
+    makes throughput ratios flaky)."""
+    out = _run(args=("--mode", "decode"))
+    extra = out["extra"]
+    assert extra["parity"] is True
+    assert extra["speedup_vs_sequential"] >= 2.0, extra
+
+
 @pytest.mark.slow
 def test_serve_bench_meets_3x_acceptance():
     """ISSUE-5 acceptance: closed-loop batched throughput >= 3x the

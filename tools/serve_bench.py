@@ -3,6 +3,7 @@
     python tools/serve_bench.py                 # closed loop (default)
     python tools/serve_bench.py --mode open
     python tools/serve_bench.py --mode both
+    python tools/serve_bench.py --mode decode   # token generation
 
 Two load models against the same frozen MLP:
 
@@ -26,11 +27,32 @@ the numbers were measured on):
                "latency_p50_ms": .., "latency_p95_ms": ..,
                "latency_p99_ms": .., "shed_rate": .., "parity": true}}
 
+`--mode decode` benches the generation path instead (ISSUE-6): a small
+GPT decoder is frozen into a `DecodeEngine` and driven two ways —
+**sequential** (one request at a time through its own KV-cached
+prefill + step loop: the no-continuous-batching deployment story) and
+**continuous** (`ContinuousBatchScheduler`: all requests offered at
+once, sequences joining/leaving the fixed-shape step between tokens).
+The record carries tokens/s for both, the speedup (acceptance: >= 2x
+at token parity), TTFT and inter-token latency percentiles, and the
+eviction rate:
+
+    {"metric": "serving_decode_throughput", "value": .., "unit":
+     "tok/s", "platform": "cpu",
+     "extra": {"sequential_tok_s": .., "speedup_vs_sequential": ..,
+               "ttft_p50_ms": .., "intertoken_p50_ms": ..,
+               "eviction_rate": .., "parity": true}}
+
 Env knobs (flags win): MXTPU_SERVE_BENCH_CLIENTS (16),
 MXTPU_SERVE_BENCH_REQUESTS (640 total), MXTPU_SERVE_BENCH_SERIAL (160),
 MXTPU_SERVE_BENCH_FEATURES (256), MXTPU_SERVE_BENCH_HIDDEN (256),
 MXTPU_SERVE_BENCH_RATE (open-loop offered req/s, 2000),
 MXTPU_SERVE_BENCH_QUEUE (open-loop queue depth, 64).
+Decode knobs: MXTPU_SERVE_BENCH_DECODE_SEQS (24 prompts),
+MXTPU_SERVE_BENCH_DECODE_SLOTS (8 cache slots),
+MXTPU_SERVE_BENCH_DECODE_NEW (16 tokens/request),
+MXTPU_SERVE_BENCH_DECODE_PROMPT (12 max prompt tokens),
+MXTPU_SERVE_BENCH_DECODE_LAYERS/HEADS/EMBED/VOCAB (2/2/32/128).
 """
 from __future__ import annotations
 
@@ -178,10 +200,105 @@ def run_open(server, xs, rate, total_requests):
     }
 
 
+def _decode_sequential(engine, prompts, new_tokens):
+    """The pre-continuous-batching story: one request at a time through
+    its own prefill + single-token step loop (still KV-cached — the
+    baseline isolates the BATCHING win, not the cache win)."""
+    outs = []
+    t0 = time.perf_counter()
+    for prompt in prompts:
+        slot = engine.free_slots[0]
+        toks = [engine.prefill(prompt, slot)]
+        while len(toks) < new_tokens and not engine.slot_full(slot):
+            toks.append(int(engine.step()[slot]))
+        engine.retire(slot)
+        outs.append(toks)
+    wall = time.perf_counter() - t0
+    total = sum(len(t) for t in outs)
+    return outs, total / wall if wall > 0 else 0.0
+
+
+def run_decode(args_ns):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTDecoder
+    from mxnet_tpu.serving import ContinuousBatchScheduler, DecodeEngine
+
+    seqs = _env_int("MXTPU_SERVE_BENCH_DECODE_SEQS", 24)
+    slots = _env_int("MXTPU_SERVE_BENCH_DECODE_SLOTS", 8)
+    new_tokens = _env_int("MXTPU_SERVE_BENCH_DECODE_NEW", 16)
+    max_prompt = _env_int("MXTPU_SERVE_BENCH_DECODE_PROMPT", 12)
+    layers = _env_int("MXTPU_SERVE_BENCH_DECODE_LAYERS", 2)
+    heads = _env_int("MXTPU_SERVE_BENCH_DECODE_HEADS", 2)
+    embed = _env_int("MXTPU_SERVE_BENCH_DECODE_EMBED", 32)
+    vocab = _env_int("MXTPU_SERVE_BENCH_DECODE_VOCAB", 128)
+    max_seq_len = max_prompt + new_tokens
+
+    np.random.seed(13)
+    block = GPTDecoder(vocab, max_seq_len=max_seq_len,
+                       num_layers=layers, num_heads=heads,
+                       embed_dim=embed)
+    block.initialize(mx.init.Xavier(magnitude=2.5))
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(1, vocab,
+                           size=rng.randint(2, max_prompt + 1))
+               for _ in range(seqs)]
+    seq_engine = DecodeEngine(block, max_slots=1, name="decode_seq")
+    buckets = sorted({seq_engine.bucket_for(len(p)) for p in prompts})
+    seq_engine.warmup(buckets=buckets)
+    seq_outs, seq_tok_s = _decode_sequential(seq_engine, prompts,
+                                             new_tokens)
+
+    engine = DecodeEngine(block, max_slots=slots, name="decode_cb")
+    engine.warmup(buckets=buckets)
+    sched = ContinuousBatchScheduler(engine,
+                                     max_new_tokens=new_tokens).start()
+    t0 = time.perf_counter()
+    handles = [sched.submit(p) for p in prompts]
+    cb_outs = [list(h.result(timeout=600)) for h in handles]
+    wall = time.perf_counter() - t0
+    stats = sched.stats()
+    sched.drain(timeout=60)
+
+    total_tokens = sum(len(t) for t in cb_outs)
+    cb_tok_s = total_tokens / wall if wall > 0 else 0.0
+    ttfts = [h.ttft() for h in handles if h.ttft() is not None]
+    gaps = []
+    for h in handles:
+        ts = h.token_times
+        gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+    return {
+        "metric": "serving_decode_throughput",
+        "value": round(cb_tok_s, 2), "unit": "tok/s",
+        "extra": {
+            "sequences": seqs, "slots": slots,
+            "new_tokens": new_tokens, "max_seq_len": max_seq_len,
+            "layers": layers, "heads": heads, "embed": embed,
+            "vocab": vocab, "prefill_buckets": buckets,
+            "tokens": total_tokens, "wall_s": round(wall, 4),
+            "sequential_tok_s": round(seq_tok_s, 2),
+            "speedup_vs_sequential": round(cb_tok_s / seq_tok_s, 3)
+            if seq_tok_s else 0.0,
+            "parity": bool(all(a == b for a, b
+                               in zip(seq_outs, cb_outs))),
+            "ttft_p50_ms": round(_percentile_ms(ttfts, 0.50), 3),
+            "ttft_p95_ms": round(_percentile_ms(ttfts, 0.95), 3),
+            "ttft_p99_ms": round(_percentile_ms(ttfts, 0.99), 3),
+            "intertoken_p50_ms": round(_percentile_ms(gaps, 0.50), 3),
+            "intertoken_p95_ms": round(_percentile_ms(gaps, 0.95), 3),
+            "intertoken_p99_ms": round(_percentile_ms(gaps, 0.99), 3),
+            "eviction_rate": stats["evicted"] /
+            max(1, stats["submitted"]),
+            "steps": stats["steps"],
+            "compiled_programs": stats["compiled_programs"],
+        },
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="serving load generator (closed/open loop)")
-    parser.add_argument("--mode", choices=("closed", "open", "both"),
+        description="serving load generator (closed/open/decode)")
+    parser.add_argument("--mode",
+                        choices=("closed", "open", "both", "decode"),
                         default="closed")
     parser.add_argument("--clients", type=int,
                         default=_env_int("MXTPU_SERVE_BENCH_CLIENTS", 16))
@@ -200,6 +317,13 @@ def main(argv=None):
     args_ns = parser.parse_args(argv)
 
     import jax
+
+    if args_ns.mode == "decode":
+        record = run_decode(args_ns)
+        record["platform"] = jax.default_backend()
+        print(json.dumps(record))
+        return 0
+
     from mxnet_tpu.serving import InferenceEngine, ModelServer
 
     sym, params = _build_model(args_ns.features, args_ns.hidden)

@@ -65,13 +65,15 @@ def load_records(path):
 
 
 def summarize(records):
-    # serving batch records ride the same stream (source="serving");
-    # they describe ~ms service times, not training steps, and would
-    # turn the headline percentiles and samples/sec into a meaningless
-    # blend — the serving section below covers them, the headline
-    # covers everything else (a serving-only file keeps its records)
+    # serving batch records (source="serving") and decode step/request
+    # records (source="decode") ride the same stream; they describe
+    # ~ms service times, not training steps, and would turn the
+    # headline percentiles and samples/sec into a meaningless blend —
+    # their sections below cover them, the headline covers everything
+    # else (a serving-only file keeps its records)
     core = [r for r in records
-            if not str(r.get("source", "")).startswith("serving")] \
+            if not str(r.get("source", "")).startswith(("serving",
+                                                        "decode"))] \
         or records
     step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
@@ -159,6 +161,41 @@ def summarize(records):
             int(r.get("queue_depth", 0)) for r in serving)
         summary["serving_shed"] = max(
             int(r.get("shed_total", 0)) for r in serving)
+    # decode section (docs/serving.md): ContinuousBatchScheduler emits
+    # one record per decode step (step_time = whole-batch step service
+    # time) and one per finished request (event="request", with TTFT
+    # and the request's mean inter-token gap)
+    steps = [r for r in records if r.get("source") == "decode"
+             and r.get("event") != "request"]
+    reqs = [r for r in records if r.get("source") == "decode"
+            and r.get("event") == "request"]
+    if steps or reqs:
+        step_t = sorted(float(r["step_time"]) for r in steps)
+        tokens = sum(int(r.get("tokens", 0)) for r in steps)
+        fills = [float(r["fill_ratio"]) for r in steps
+                 if "fill_ratio" in r]
+        summary["decode_steps"] = len(steps)
+        summary["decode_tokens"] = tokens
+        if step_t and sum(step_t) > 0:
+            summary["decode_tokens_per_sec"] = tokens / sum(step_t)
+            summary["decode_step_p50_s"] = _percentile(step_t, 0.50)
+            summary["decode_step_p95_s"] = _percentile(step_t, 0.95)
+        if fills:
+            summary["decode_fill_mean"] = sum(fills) / len(fills)
+        if steps:
+            summary["decode_evictions"] = max(
+                int(r.get("evictions_total", 0)) for r in steps)
+        summary["decode_requests"] = len(reqs)
+        if reqs:
+            ttfts = sorted(float(r.get("ttft_s", 0.0)) for r in reqs)
+            gaps = sorted(float(r.get("intertoken_s", 0.0))
+                          for r in reqs)
+            summary["decode_ttft_p50_s"] = _percentile(ttfts, 0.50)
+            summary["decode_ttft_p95_s"] = _percentile(ttfts, 0.95)
+            summary["decode_ttft_p99_s"] = _percentile(ttfts, 0.99)
+            summary["decode_intertoken_p50_s"] = _percentile(gaps, 0.50)
+            summary["decode_intertoken_p95_s"] = _percentile(gaps, 0.95)
+            summary["decode_intertoken_p99_s"] = _percentile(gaps, 0.99)
     return summary
 
 
@@ -224,6 +261,27 @@ def format_summary(s):
             "queue max %d"
             % (s["serving_batch_p50_s"], s["serving_batch_p95_s"],
                s["serving_batch_p99_s"], s["serving_queue_depth_max"]))
+    if "decode_steps" in s:
+        lines.append(
+            "  decode      %d steps  %d tokens (%.0f tok/s)  "
+            "fill %.0f%%  evictions %d"
+            % (s["decode_steps"], s["decode_tokens"],
+               s.get("decode_tokens_per_sec", 0.0),
+               100.0 * s.get("decode_fill_mean", 0.0),
+               s.get("decode_evictions", 0)))
+        if s.get("decode_requests"):
+            lines.append(
+                "              %d requests  ttft p50 %.4fs  p95 %.4fs  "
+                "p99 %.4fs"
+                % (s["decode_requests"], s["decode_ttft_p50_s"],
+                   s["decode_ttft_p95_s"], s["decode_ttft_p99_s"]))
+            lines.append(
+                "              inter-token p50 %.4fs  p95 %.4fs  "
+                "p99 %.4fs  step p50 %.4fs"
+                % (s["decode_intertoken_p50_s"],
+                   s["decode_intertoken_p95_s"],
+                   s["decode_intertoken_p99_s"],
+                   s.get("decode_step_p50_s", 0.0)))
     return "\n".join(lines)
 
 
