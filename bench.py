@@ -43,6 +43,48 @@ def _flag(name, default="1"):
     return os.environ.get(name, default) not in ("0", "false")
 
 
+# device-lease bookkeeping for the BENCH record (ISSUE 7): a failed
+# round must be diagnosable from the record alone — how many probes it
+# took, whether a stale lease was taken over, and who held it
+_LEASE = None
+_PROBE_INFO = {"probes": 0, "takeovers": 0, "lease_holder": None}
+
+
+def _acquire_device_lease():
+    """The probe path owns device acquisition now: a cooperative
+    on-disk lease (resilience/lease.py) with hard-timeout takeover
+    replaces the old skip-and-pray kill_stale ladder. A wedged previous
+    holder (stale heartbeat) is reclaimed — SIGTERM→SIGKILL with grace,
+    no --force — while a LIVE holder with a fresh heartbeat becomes a
+    clean diagnosable exit instead of 35 minutes of doomed retries."""
+    global _LEASE
+    from mxnet_tpu.resilience.lease import DeviceLease, LeaseHeld
+    if os.environ.get("MXTPU_LEASE", "") in ("0", "false"):
+        return None      # explicit opt-out; bench otherwise ALWAYS
+        # leases — even a cpu-pinned run wants measurement exclusivity
+    if _LEASE is not None and _LEASE.held():
+        return _LEASE
+    lease = DeviceLease(what="bench")
+    try:
+        lease.acquire()      # MXTPU_LEASE_ACQUIRE_S bounds the wait
+    except LeaseHeld as err:
+        _PROBE_INFO["lease_holder"] = err.holder
+        raise SystemExit("bench: %s" % err)
+    _LEASE = lease
+    import atexit
+    atexit.register(lease.release)
+    _PROBE_INFO["takeovers"] = lease.takeovers
+    if lease.taken_over_from:
+        # the party that mattered: who was wedged on the device before
+        # this run reclaimed it (trim to the diagnosable fields)
+        _PROBE_INFO["lease_holder"] = {
+            k: lease.taken_over_from.get(k)
+            for k in ("pid", "host", "what", "cmdline", "heartbeat")}
+    else:
+        _PROBE_INFO["lease_holder"] = lease.state().get("holder")
+    return lease
+
+
 def _apply_platform_override():
     """MXTPU_BENCH_PLATFORM=cpu pins the backend via jax.config (for CI
     smoke runs — the env-var spelling can still race plugin discovery
@@ -60,12 +102,15 @@ def _probe_devices(timeout_s=180, parent_init=True, retries=None):
 
     Each probe runs in a FRESH interpreter: a PJRT init that timed out
     leaves this process's jax wedged on the init lock, so an in-process
-    retry can never succeed. Between attempts, reap stale framework
-    processes that may be blocking the device lease (tools/kill_stale.py,
-    the reference kill-mxnet.py role) and back off — relay-side lease
-    wedges clear with time, not force.
+    retry can never succeed. The probe loop first ACQUIRES the host
+    device lease (stale holders are taken over — resilience/lease.py;
+    a live fresh holder is a clean diagnosable exit). Between failed
+    attempts, reap stale framework processes that may still be blocking
+    the PJRT pool (tools/kill_stale.py, now lease-aware) and back off —
+    relay-side lease wedges clear with time, not force.
     """
     import subprocess
+    _acquire_device_lease()
     # 6 probes spanning ~35 min by default: relay-lease wedges clear
     # with time (round 4 evidence), so a short probe burst undersamples
     # (callers with a CPU fallback pass a smaller retries)
@@ -75,14 +120,22 @@ def _probe_devices(timeout_s=180, parent_init=True, retries=None):
     plat = os.environ.get("MXTPU_BENCH_PLATFORM")
     pin = ("import jax; jax.config.update('jax_platforms', %r); " % plat
            if plat else "")
-    code = (pin + "from mxnet_tpu.base import probe_devices; import sys; "
-            "d, e = probe_devices(%d); "
-            "sys.stderr.write('' if d else str(e)); "
-            "d and sys.stdout.write(d[0].platform); "
-            "sys.exit(0 if d else 1)" % timeout_s)
+    # the child probes through the health watchdog: a trip reports the
+    # typed DeviceUnreachable WITH the lease-holder + /proc diagnostics
+    # on stderr, so the failure record names the culprit
+    code = (pin + "import sys\n"
+            "from mxnet_tpu.resilience.watchdog import (HealthWatchdog, "
+            "DeviceUnreachable)\n"
+            "try:\n"
+            "    d = HealthWatchdog(init_timeout_s=%d).init_devices()\n"
+            "except DeviceUnreachable as e:\n"
+            "    sys.stderr.write(str(e))\n"
+            "    sys.exit(1)\n"
+            "sys.stdout.write(d[0].platform)\n" % timeout_s)
     err = "?"
     here = os.path.dirname(os.path.abspath(__file__))
     for attempt in range(max(retries, 1)):
+        _PROBE_INFO["probes"] += 1
         try:
             # belt over the in-child deadline: if the child itself wedges
             # (e.g. PJRT init stuck in a C call holding the GIL so even
@@ -525,6 +578,11 @@ def main():
         sys.stderr.write("bench: %s; falling back to the CPU backend\n"
                          % err)
         _fallback_to_cpu()
+        if _LEASE is None:
+            # the SystemExit was a live holder owning the lease
+            # (LeaseHeld): the CPU fallback doesn't need the device —
+            # don't wait out a SECOND acquire timeout just to die again
+            os.environ["MXTPU_LEASE"] = "0"
         _probe_devices(parent_init=not ladder_mode)
     else:
         if plat == "cpu" and fallback_ok:
@@ -540,7 +598,9 @@ def main():
 
     def emit():
         rec = dict(best)
-        rec["extra"] = dict(extra, ladder=dict(ladder))
+        # probe/lease outcome ride every emitted record: a failed or
+        # degraded round is diagnosable from the BENCH json alone
+        rec["extra"] = dict(extra, ladder=dict(ladder), **_PROBE_INFO)
         print(json.dumps(rec), flush=True)
 
     for name, steps, unr, score, extras, deadline in _rungs():
@@ -613,6 +673,11 @@ def _measure_main():
         })
     if _flag("MXTPU_BENCH_EXTRAS"):
         extra.update(_extra_metrics(rng, t_start))
+    if _PROBE_INFO["probes"]:
+        # non-ladder parent measured in-process: its record carries the
+        # probe/lease outcome directly (rung children never probe —
+        # the ladder parent merges _PROBE_INFO at emit instead)
+        extra.update(_PROBE_INFO)
 
     print(json.dumps({
         "metric": "resnet50_v1_train_throughput_b%d" % BATCH,

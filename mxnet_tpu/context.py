@@ -79,7 +79,29 @@ class Context:
         return ctx
 
 
+_backend_guard = {"checked": False}
+
+
+def _ensure_backend_alive():
+    """First backend touch goes through the health watchdog: a dead
+    accelerator tunnel raises a typed `DeviceUnreachable` with lease-
+    holder diagnostics instead of hanging `jax.devices()` forever (the
+    BENCH_r03–r05 mode). `MXTPU_WATCHDOG_INIT_S=0` disables; every
+    later call is one flag check."""
+    if _backend_guard["checked"]:
+        return
+    from .base import getenv
+    timeout = getenv("MXTPU_WATCHDOG_INIT_S", 180.0)
+    if timeout > 0:
+        from .resilience.watchdog import HealthWatchdog
+        HealthWatchdog(init_timeout_s=timeout).init_devices()
+    # only a successful probe latches: a DeviceUnreachable caller that
+    # retries after recovery must be re-checked, not waved through
+    _backend_guard["checked"] = True
+
+
 def _devices_for(device_type):
+    _ensure_backend_alive()
     # LOCAL devices only: in a multi-process (dist kvstore) run each
     # worker's ctx ids index its own addressable devices, like the
     # reference where every worker sees its own gpu(0)

@@ -28,9 +28,11 @@ from ..kvstore import (KVStore, _key_value, _nbytes, _priority_order,
                        _sum_arrays, _PUSH_BYTES, _PUSH_CALLS,
                        _PUSH_SECONDS)
 from ..observability import registry as _obs
+from ..resilience import lease as _lease
 from ..resilience.chaos import chaos_point, InjectedFailure
-from ..resilience.retry import (RetryPolicy, TransientError, retry_call,
-                                run_with_deadline)
+from ..resilience.retry import (DeadlineExceeded, RetryPolicy,
+                                TransientError, retry_call)
+from ..resilience.watchdog import HealthWatchdog
 from .bucketing import (GradBucketer, BUCKET_COUNT, BUCKET_KEYS,
                         BUCKET_FILL, PACK_SECONDS, UNPACK_SECONDS)
 
@@ -69,6 +71,17 @@ def _enable_cpu_collectives():
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
+
+
+def _lease_wanted():
+    """Hold the host device lease for this training process? Yes on
+    accelerator targets (L5 execution owns device acquisition —
+    ISSUE 7); no on explicit-CPU runs, where N cooperating processes
+    per host (tests, gloo collectives) legitimately share the backend.
+    `lease.lease_wanted` decides from config/env, NOT backend state —
+    querying the backend here would initialize it before
+    jax.distributed does."""
+    return _lease.lease_wanted()
 
 
 class _AlreadyInitialized(MXNetError):
@@ -114,21 +127,46 @@ def init_distributed(coordinator_address=None, num_processes=None,
     if timeout > 0:
         kwargs["initialization_timeout"] = int(timeout)
     _enable_cpu_collectives()
+    if _lease_wanted():
+        # L5 execution owns device acquisition (ISSUE 7): take the
+        # host's cooperative lease BEFORE dialing the coordinator, so a
+        # wedged previous holder is reclaimed (hard-timeout takeover)
+        # instead of blocking this process's backend init. The hold is
+        # process-wide and refcounted; serving shares it.
+        _lease.hold(what="train")
+    watchdog = HealthWatchdog()
 
     def _attempt():
         chaos_point("dist.init")
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id, **kwargs)
-        except RuntimeError as err:
-            if "already initialized" in str(err).lower():
-                # a partially-successful earlier attempt (or foreign
-                # code) got there first: surface THAT, not N retries
-                # of the same complaint masking the root cause
-                raise _AlreadyInitialized(str(err)) from err
-            raise
+
+        def _initialize():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id, **kwargs)
+            except RuntimeError as err:
+                if "already initialized" in str(err).lower():
+                    # a partially-successful earlier attempt (or foreign
+                    # code) got there first: surface THAT, not N retries
+                    # of the same complaint masking the root cause
+                    raise _AlreadyInitialized(str(err)) from err
+                raise
+
+        # the watchdog is the belt over jax's own
+        # initialization_timeout (explicit, else jax's 300s default):
+        # it must sit strictly ABOVE that budget — a watchdog that
+        # trips first would abort a rendezvous jax itself still
+        # considers healthy — so a coordinator RPC that wedges past
+        # BOTH deadlines trips with holder diagnostics instead of
+        # hanging the attempt forever
+        jax_budget = (timeout if timeout > 0 else 300.0) + 30.0
+        guard_t = (max(watchdog.init_timeout_s, jax_budget)
+                   if watchdog.init_timeout_s > 0 else 0.0)
+        watchdog.guard_init(_initialize,
+                            what="jax.distributed.initialize(%s)"
+                            % coordinator_address,
+                            timeout_s=guard_t)
 
     retry_call(_attempt, policy=RetryPolicy(
         max_attempts=getenv("MXTPU_DIST_INIT_RETRIES", 3),
@@ -136,7 +174,12 @@ def init_distributed(coordinator_address=None, num_processes=None,
         max_delay=30.0,
         retry_on=(TransientError, RuntimeError, ConnectionError, OSError,
                   TimeoutError),
-        give_up_on=(InjectedFailure, _AlreadyInitialized),
+        # a tripped init watchdog (DeadlineExceeded) is NEVER silently
+        # retried: the wedged first attempt still runs on its daemon
+        # thread, and a concurrent re-initialize would mask the real
+        # timeout behind an "already initialized" complaint
+        give_up_on=(InjectedFailure, _AlreadyInitialized,
+                    DeadlineExceeded),
         what="dist.init"))
     _dist_initialized = True
 
@@ -152,6 +195,10 @@ class DistKVStore(KVStore):
         self._mesh = None
         self._reduce = None
         self._bucketer = GradBucketer()  # MXTPU_BUCKET_MB
+        # hung-collective monitor (ISSUE 7): barrier always bounded
+        # (MXTPU_BARRIER_TIMEOUT_S), per-bucket collectives bounded
+        # when MXTPU_WATCHDOG_COLLECTIVE_S is set
+        self._watchdog = HealthWatchdog()
 
     def set_bucket_size_mb(self, mb):
         """Retarget the fusion-bucket size for the bucketed exchange
@@ -284,9 +331,18 @@ class DistKVStore(KVStore):
         BUCKET_KEYS.inc(len(bucket.keys))
         BUCKET_FILL.observe(bucket.nbytes /
                             max(1, self._bucketer.target_bytes))
+        # the collective itself rides the hung-collective watchdog: a
+        # dead peer trips a diagnosable DeadlineExceeded (with lease
+        # holder dump) instead of blocking this worker forever; the
+        # push retry policy does NOT retry it — clean abort
         if bucket.lane:
-            return self._bucket_sum_compressed(flat, bucket)
-        return self._cross_process_sum(flat)
+            return self._watchdog.guard_collective(
+                lambda: self._bucket_sum_compressed(flat, bucket),
+                what="compressed bucket allreduce (%d keys)"
+                % len(bucket.keys))
+        return self._watchdog.guard_collective(
+            lambda: self._cross_process_sum(flat),
+            what="bucket allreduce (%d keys)" % len(bucket.keys))
 
     def _bucket_sum_compressed(self, flat, bucket):
         """Compressed bucket collective. Residuals stay PER KEY (read
@@ -471,12 +527,13 @@ class DistKVStore(KVStore):
 
         Bounded by MXTPU_BARRIER_TIMEOUT_S (default 600): when a peer
         dies mid-run the collective would otherwise block this process
-        forever (the round-5 wedge mode) — a diagnosable
-        DeadlineExceeded names the barrier and the budget instead."""
+        forever (the round-5 wedge mode) — the health watchdog trips a
+        diagnosable DeadlineExceeded naming the barrier and the budget
+        (plus the lease-holder dump) instead."""
         if self._nproc > 1:
             from jax.experimental import multihost_utils
-            run_with_deadline(
+            self._watchdog.guard_collective(
                 lambda: multihost_utils.sync_global_devices(
                     "mxnet_tpu_kv_barrier"),
-                getenv("MXTPU_BARRIER_TIMEOUT_S", 600.0),
-                what="kvstore barrier across %d processes" % self._nproc)
+                what="kvstore barrier across %d processes" % self._nproc,
+                timeout_s=getenv("MXTPU_BARRIER_TIMEOUT_S", 600.0))

@@ -20,7 +20,14 @@ missing machinery, wired through the runtime at named sites:
              checkpoint save at the next step boundary plus a
              diagnosable `TrainingPreempted`.
 - `atomic`:  `atomic_write` (temp file + os.replace) so a killed process
-             never leaves a truncated .params/.states blob.
+             never leaves a truncated .params/.states blob, and
+             `exclusive_create` (O_EXCL) — the lease-acquire primitive.
+- `lease`:   `DeviceLease`, the cooperative on-disk device lease with
+             heartbeat + hard-timeout takeover (one path to the
+             accelerator for bench/serving/training; ISSUE 7).
+- `watchdog`: `HealthWatchdog` / `DeviceUnreachable` — deadline-bounded
+             device init and hung-collective monitoring with holder
+             diagnostics on trip.
 - `metrics`: process-wide counters (injected faults, skipped corrupt
              records) surfaced for monitoring.
 """
@@ -30,7 +37,9 @@ from .chaos import (chaos_point, configure, reset, trip_count,
                     parse_spec, InjectedFault, InjectedFailure)
 from .preempt import (PreemptionGuard, TrainingPreempted,
                       at_step_boundary, preemption_requested)
-from .atomic import atomic_write
+from .atomic import atomic_write, exclusive_create
+from .lease import DeviceLease, LeaseHeld
+from .watchdog import DeviceUnreachable, HealthWatchdog
 from . import metrics
 from .metrics import counters
 
@@ -39,4 +48,6 @@ __all__ = ["RetryPolicy", "retry", "retry_call", "Deadline",
            "chaos_point", "configure", "reset", "trip_count",
            "parse_spec", "InjectedFault", "InjectedFailure",
            "PreemptionGuard", "TrainingPreempted", "at_step_boundary",
-           "preemption_requested", "atomic_write", "metrics", "counters"]
+           "preemption_requested", "atomic_write", "exclusive_create",
+           "DeviceLease", "LeaseHeld", "DeviceUnreachable",
+           "HealthWatchdog", "metrics", "counters"]

@@ -24,8 +24,11 @@ Injection sites wired through the runtime: `kvstore.push`, `dist.init`,
 `checkpoint.save`, `io.read`, `engine.host_push`, `serving.infer`,
 `serving.decode` (fires before every continuous-batching decode step;
 kind=sleep stretches steps so deadline eviction can be exercised,
-kind=raise fails every in-flight sequence). A `chaos_point(site)` call
-is free when no spec is configured (one dict lookup).
+kind=raise fails every in-flight sequence), `lease.acquire` (before a
+`DeviceLease.acquire` touches the lease file), and `device.init`
+(before `HealthWatchdog.init_devices` probes the backend — kind=sleep
+exercises the init deadline). A `chaos_point(site)` call is free when
+no spec is configured (one dict lookup).
 """
 from __future__ import annotations
 

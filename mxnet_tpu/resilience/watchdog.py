@@ -1,0 +1,178 @@
+"""Deadline-bounded device init and hung-collective monitoring.
+
+The two hang modes the bench history records (BENCH_r03–r05, PERF.md
+§8) are (1) PJRT backend init blocking forever behind a wedged lease
+holder, and (2) a cross-process collective blocking forever because a
+peer died mid-run. `HealthWatchdog` bounds both:
+
+* `init_devices()` wraps `base.probe_devices` (the daemon-thread
+  probe) with a deadline; on trip it dumps the lease holder plus its
+  /proc state and raises a typed `DeviceUnreachable` — callers
+  (`Context` backend init, bench's probe child, `init_distributed`)
+  get a diagnosable error instead of a hang.
+* `guard_collective()` runs a collective (`DistKVStore.barrier`, one
+  bucketed allreduce) under `resilience.retry.run_with_deadline`; a
+  trip dumps the same diagnostics, bumps `resilience.watchdog.trips`,
+  and re-raises the `DeadlineExceeded` so the caller aborts cleanly.
+
+Every trip is counted (`resilience.watchdog.trips{kind=...}`) and, when
+``MXTPU_TELEMETRY`` streams, recorded as a `source="resilience"`
+`watchdog_trip` event — so a failed round is diagnosable from the
+telemetry file alone (tools/telemetry_report.py's lease/watchdog
+section).
+
+Env knobs (docs/fault_tolerance.md):
+  MXTPU_WATCHDOG_INIT_S        device-init deadline (180; 0 disables)
+  MXTPU_WATCHDOG_COLLECTIVE_S  default collective deadline when the
+                               call site doesn't pass one (0 = off)
+"""
+from __future__ import annotations
+
+import time
+
+from ..base import MXNetError, getenv, probe_devices
+from ..observability import registry as _obs
+from ..observability import telemetry as _tele
+from . import lease as _lease
+from .chaos import chaos_point
+from .retry import DeadlineExceeded, run_with_deadline
+
+__all__ = ["DeviceUnreachable", "HealthWatchdog", "diagnostics"]
+
+TRIPS = _obs.counter(
+    "resilience.watchdog.trips",
+    "Watchdog deadline trips (label kind: init / collective)")
+
+_log = None
+
+
+def _logger():
+    global _log
+    if _log is None:
+        from ..log import get_logger
+        _log = get_logger("mxnet_tpu.resilience")
+    return _log
+
+
+class DeviceUnreachable(MXNetError):
+    """Device backend init failed or timed out. The message carries the
+    probe error plus the lease/holder diagnostics; `.diagnostics` holds
+    the dump alone for machine consumers."""
+
+    def __init__(self, msg, diagnostics=None):
+        super().__init__(msg + ("\n" + diagnostics if diagnostics else ""))
+        self.diagnostics = diagnostics
+
+
+def _read_proc(pid, name):
+    try:
+        with open("/proc/%d/%s" % (pid, name), "rb") as f:
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def diagnostics(lease_path=None):
+    """One-string dump for a tripped watchdog: the lease holder (the
+    prime suspect for an init hang) and its /proc state — enough for a
+    post-mortem without a live session."""
+    path = lease_path or _lease.default_lease_path()
+    lines = []
+    rec = _lease.read_lease(path)
+    if rec is None:
+        lines.append("lease %s: no holder recorded" % path)
+    else:
+        age = time.time() - float(rec.get("heartbeat",
+                                          rec.get("created", 0.0)))
+        lines.append(
+            "lease %s: holder pid %s on %s (role %r), heartbeat %.1fs "
+            "ago (takeover at %.6gs)"
+            % (path, rec.get("pid"), rec.get("host"), rec.get("what"),
+               age, rec.get("takeover_s", 0.0)))
+        pid = rec.get("pid")
+        if isinstance(pid, int) and pid > 0:
+            stat = _read_proc(pid, "stat")
+            if stat:
+                fields = stat.rsplit(")", 1)[-1].split()
+                state = fields[0] if fields else "?"
+                lines.append("holder /proc: state %s  cmdline %r  "
+                             "wchan %s"
+                             % (state,
+                                _read_proc(pid, "cmdline")
+                                .replace("\0", " ").strip()[:120],
+                                _read_proc(pid, "wchan").strip() or "?"))
+            else:
+                lines.append("holder /proc: pid %d is gone" % pid)
+    return "\n".join(lines)
+
+
+class HealthWatchdog:
+    """Deadline policies for the two hang-prone device paths (module
+    docstring). One instance per subsystem is fine — state is just the
+    configured budgets."""
+
+    def __init__(self, init_timeout_s=None, collective_timeout_s=None,
+                 lease_path=None):
+        self.init_timeout_s = float(
+            init_timeout_s if init_timeout_s is not None
+            else getenv("MXTPU_WATCHDOG_INIT_S", 180.0))
+        self.collective_timeout_s = float(
+            collective_timeout_s if collective_timeout_s is not None
+            else getenv("MXTPU_WATCHDOG_COLLECTIVE_S", 0.0))
+        self.lease_path = lease_path
+
+    def init_devices(self, timeout_s=None, probe=None):
+        """Deadline-bounded backend init: returns the device list or
+        raises `DeviceUnreachable` with holder diagnostics. `probe` is
+        `(timeout_s) -> (devices|None, err)` — `base.probe_devices` by
+        default, injectable for tests (the fake backend)."""
+        chaos_point("device.init")
+        t = float(timeout_s if timeout_s is not None
+                  else self.init_timeout_s)
+        probe = probe or probe_devices
+        if t <= 0:      # watchdog disabled: direct (possibly hanging) init
+            import jax
+            return jax.devices()
+        devs, err = probe(t)
+        if devs is not None:
+            return devs
+        diag = self._trip("init", "device backend init", t)
+        raise DeviceUnreachable(
+            "device backend unreachable: %s (init bounded at %.6gs)"
+            % (err, t), diag)
+
+    def guard_collective(self, fn, what="collective", timeout_s=None):
+        """Run `fn()` under a deadline; a trip dumps diagnostics and
+        re-raises the `DeadlineExceeded` (clean abort — the process
+        state is suspect, never silently retried). `timeout_s` 0/None
+        falls back to the instance default; 0 there means unguarded."""
+        return self._guard(fn, what, timeout_s,
+                           self.collective_timeout_s, "collective")
+
+    def guard_init(self, fn, what="backend init", timeout_s=None):
+        """Like guard_collective but for init-shaped work (trips count
+        under kind=init): bounds calls such as
+        `jax.distributed.initialize` that can block forever on a dead
+        coordinator."""
+        return self._guard(fn, what, timeout_s, self.init_timeout_s,
+                           "init")
+
+    def _guard(self, fn, what, timeout_s, default_t, kind):
+        t = float(timeout_s if timeout_s is not None else default_t)
+        if t <= 0:
+            return fn()
+        try:
+            return run_with_deadline(fn, t, what=what)
+        except DeadlineExceeded as err:
+            diag = self._trip(kind, what, t)
+            raise DeadlineExceeded("%s\n%s" % (err, diag)) from err
+
+    def _trip(self, kind, what, budget):
+        TRIPS.inc(kind=kind)
+        diag = diagnostics(self.lease_path)
+        _logger().error("watchdog trip (%s): %s exceeded %.6gs\n%s",
+                        kind, what, budget, diag)
+        _tele.emit({"ts": time.time(), "source": "resilience",
+                    "event": "watchdog_trip", "kind": kind,
+                    "what": what, "step_time": float(budget)})
+        return diag

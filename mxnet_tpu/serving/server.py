@@ -33,6 +33,7 @@ from ..base import MXNetError, getenv
 from ..observability import registry as _obs
 from ..observability import telemetry as _telemetry
 from ..resilience import chaos_point
+from ..resilience import lease as _lease
 from .batcher import DynamicBatcher, ServerClosed
 from .decode import DecodeEngine
 from .engine import InferenceEngine
@@ -181,9 +182,37 @@ class ModelServer:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+    def _acquire_lease(self):
+        """Hold the host's cooperative device lease for the server's
+        lifetime (ISSUE 7: L5 execution owns device acquisition) — the
+        process-wide refcounted hold, so N servers in one process share
+        one grant. CPU targets skip it by default (a test mesh is not
+        a device to serialize on); MXTPU_LEASE=1 forces, =0 forbids.
+        The decision is config/env-based (`lease_wanted`) — querying
+        the backend here would initialize the very thing the lease
+        gates, hanging behind the wedged holder it exists to clear."""
+        if not _lease.lease_wanted():
+            return
+        self._lease = _lease.hold(what="serving")
+
+    def _release_lease(self):
+        if getattr(self, "_lease", None) is not None:
+            self._lease = None
+            _lease.release_hold()
+
     def start(self):
         if self._started:
             return self
+        self._acquire_lease()
+        try:
+            return self._start()
+        except BaseException:
+            # a failed warmup/scheduler start must not keep squatting
+            # on the device lease for the process's remaining lifetime
+            self._release_lease()
+            raise
+
+    def _start(self):
         if self.kind == "decode":
             if self._warmup:
                 for s in self._schedulers:
@@ -243,6 +272,7 @@ class ModelServer:
             if not self._started:
                 for s in self._schedulers:
                     s.close()
+                self._release_lease()
                 return True
             deadline = None if timeout is None \
                 else time.perf_counter() + timeout
@@ -251,9 +281,12 @@ class ModelServer:
                 wait = None if deadline is None \
                     else max(0.0, deadline - time.perf_counter())
                 ok = s.drain(wait) and ok
+            if ok:
+                self._release_lease()
             return ok
         self.batcher.close()          # wakes the dispatcher
         if not self._started:
+            self._release_lease()
             return True
         deadline = None if timeout is None \
             else time.perf_counter() + timeout
@@ -277,7 +310,10 @@ class ModelServer:
             wait = None if deadline is None \
                 else max(0.0, deadline - time.perf_counter())
             w.thread.join(wait)
-        return all(not w.thread.is_alive() for w in self._workers)
+        done = all(not w.thread.is_alive() for w in self._workers)
+        if done:
+            self._release_lease()
+        return done
 
     stop = drain
 
@@ -444,6 +480,10 @@ class ModelServer:
                 "tokens": sum(p["tokens"] for p in per),
                 "queued": sum(p["queued"] for p in per),
                 "draining": self.draining,
+                # device-lease snapshot (docs/fault_tolerance.md):
+                # None on CPU backends, holder/heartbeat info when the
+                # process-wide hold is active
+                "lease": _lease.held_state(),
             }
         with self._lock:
             workers = [{
@@ -473,4 +513,5 @@ class ModelServer:
             "request_latency_p50_s": lat.percentile(0.50, **labels),
             "request_latency_p95_s": lat.percentile(0.95, **labels),
             "workers": workers,
+            "lease": _lease.held_state(),
         }

@@ -1,0 +1,133 @@
+"""CI perf-regression gate over an MXTPU_TELEMETRY JSONL stream.
+
+ROADMAP item 5's second half: the PR-2 telemetry stream becomes a
+per-PR perf gate — step-time and compile-stall budgets asserted on the
+CPU backend in CI (real-chip budgets when the device is reachable), so
+a regression fails the build instead of surfacing three rounds later
+in a BENCH record.
+
+    MXTPU_TELEMETRY=/tmp/t.jsonl python train.py ...
+    python tools/perf_gate.py /tmp/t.jsonl \
+        --max-step-p95-s 0.5 --max-compile-stall-s 20
+
+Budgets (pass at least one; a gate with no budgets asserts nothing and
+is rejected):
+
+    --max-step-p50-s / --max-step-p95-s / --max-step-mean-s
+                          headline step-time percentiles (training
+                          records only — serving/decode/resilience
+                          records are excluded, like telemetry_report)
+    --max-compile-stall-s total XLA compile seconds across the stream
+    --max-compiles        total XLA backend compiles
+    --min-samples-per-sec aggregate training throughput floor
+    --max-data-wait-frac  data-wait seconds / total step time
+    --min-steps           refuse a stream shorter than this (default 1
+                          — a truncated run must not "pass")
+
+Exit codes: 0 all budgets hold; 1 budget breach (each breach printed
+as `BREACH <name>: observed X vs budget Y`); 2 missing/empty/malformed
+telemetry or unusable budget set — the same strictness as
+telemetry_report: a gate that passes on garbage input is no gate. One
+JSON verdict line always lands on stdout. Stdlib-only.
+
+tests/test_lease.py::TestPerfGate is the tier-1 smoke; see
+docs/observability.md ("Perf gate").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from telemetry_report import (ReportError, load_records,  # noqa: E402
+                              summarize)
+
+
+def evaluate(summary, args):
+    """[(name, observed, budget, ok)] for every budget the caller set.
+    A budget whose metric is absent from the summary is a breach with
+    observed=None (e.g. --min-samples-per-sec over records without
+    batch_size): the gate demanded evidence the stream doesn't carry."""
+    checks = []
+
+    def check(name, key, budget, op):
+        if budget is None:
+            return
+        observed = summary.get(key)
+        ok = observed is not None and op(observed, budget)
+        checks.append((name, observed, budget, ok))
+
+    le = lambda a, b: a <= b          # noqa: E731
+    ge = lambda a, b: a >= b          # noqa: E731
+    check("step_p50_s", "step_time_p50_s", args.max_step_p50_s, le)
+    check("step_p95_s", "step_time_p95_s", args.max_step_p95_s, le)
+    check("step_mean_s", "step_time_mean_s", args.max_step_mean_s, le)
+    check("compile_stall_s", "compile_stall_s",
+          args.max_compile_stall_s, le)
+    check("compiles", "compile_count", args.max_compiles, le)
+    check("samples_per_sec", "samples_per_sec",
+          args.min_samples_per_sec, ge)
+    if args.max_data_wait_frac is not None:
+        total = summary.get("total_time_s") or 0.0
+        frac = (summary.get("data_wait_s", 0.0) / total) if total > 0 \
+            else None
+        checks.append(("data_wait_frac", frac, args.max_data_wait_frac,
+                       frac is not None and frac <= args.max_data_wait_frac))
+    check("steps", "steps", args.min_steps, ge)
+    return checks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Assert perf budgets over an MXTPU_TELEMETRY "
+                    "JSONL step-record stream")
+    ap.add_argument("path", help="JSONL file written by StepTimer")
+    ap.add_argument("--max-step-p50-s", type=float, default=None)
+    ap.add_argument("--max-step-p95-s", type=float, default=None)
+    ap.add_argument("--max-step-mean-s", type=float, default=None)
+    ap.add_argument("--max-compile-stall-s", type=float, default=None)
+    ap.add_argument("--max-compiles", type=float, default=None)
+    ap.add_argument("--min-samples-per-sec", type=float, default=None)
+    ap.add_argument("--max-data-wait-frac", type=float, default=None)
+    ap.add_argument("--min-steps", type=float, default=1)
+    args = ap.parse_args(argv)
+
+    budgets = (args.max_step_p50_s, args.max_step_p95_s,
+               args.max_step_mean_s, args.max_compile_stall_s,
+               args.max_compiles, args.min_samples_per_sec,
+               args.max_data_wait_frac)
+    verdict = {"path": args.path, "ok": False, "breaches": []}
+    if all(b is None for b in budgets):
+        verdict["error"] = "no budgets given — nothing to assert"
+        print(json.dumps(verdict))
+        print("perf_gate: no budgets given (see --help)",
+              file=sys.stderr)
+        return 2
+    try:
+        summary = summarize(load_records(args.path))
+    except ReportError as err:
+        verdict["error"] = str(err)
+        print(json.dumps(verdict))
+        print("perf_gate: %s" % err, file=sys.stderr)
+        return 2
+
+    checks = evaluate(summary, args)
+    breaches = [c for c in checks if not c[3]]
+    verdict.update(
+        ok=not breaches, steps=summary["steps"],
+        checks={name: {"observed": obs, "budget": bud, "ok": ok}
+                for name, obs, bud, ok in checks},
+        breaches=[name for name, _, _, ok in checks if not ok])
+    print(json.dumps(verdict, sort_keys=True))
+    for name, obs, bud, ok in breaches:
+        print("BREACH %s: observed %s vs budget %s"
+              % (name, "%.6g" % obs if obs is not None else "n/a", bud),
+              file=sys.stderr)
+    return 1 if breaches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

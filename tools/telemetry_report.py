@@ -65,15 +65,17 @@ def load_records(path):
 
 
 def summarize(records):
-    # serving batch records (source="serving") and decode step/request
-    # records (source="decode") ride the same stream; they describe
-    # ~ms service times, not training steps, and would turn the
-    # headline percentiles and samples/sec into a meaningless blend —
-    # their sections below cover them, the headline covers everything
-    # else (a serving-only file keeps its records)
+    # serving batch records (source="serving"), decode step/request
+    # records (source="decode"), and resilience events
+    # (source="resilience": lease acquires/takeovers, watchdog trips)
+    # ride the same stream; they describe service times and recovery
+    # budgets, not training steps, and would turn the headline
+    # percentiles and samples/sec into a meaningless blend — their
+    # sections below cover them, the headline covers everything else
+    # (a serving-only file keeps its records)
     core = [r for r in records
-            if not str(r.get("source", "")).startswith(("serving",
-                                                        "decode"))] \
+            if not str(r.get("source", "")).startswith(
+                ("serving", "decode", "resilience"))] \
         or records
     step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
@@ -196,6 +198,28 @@ def summarize(records):
             summary["decode_intertoken_p50_s"] = _percentile(gaps, 0.50)
             summary["decode_intertoken_p95_s"] = _percentile(gaps, 0.95)
             summary["decode_intertoken_p99_s"] = _percentile(gaps, 0.99)
+    # lease/watchdog section (docs/fault_tolerance.md): DeviceLease and
+    # HealthWatchdog emit source="resilience" events — step_time is the
+    # event's duration (acquire wait, takeover time, tripped budget)
+    res = [r for r in records if r.get("source") == "resilience"]
+    if res:
+        acq = sorted(float(r["step_time"]) for r in res
+                     if r.get("event") == "lease_acquire")
+        takeovers = [r for r in res if r.get("event") == "lease_takeover"]
+        trips = [r for r in res if r.get("event") == "watchdog_trip"]
+        summary["lease_acquires"] = len(acq)
+        if acq:
+            summary["lease_acquire_p95_s"] = _percentile(acq, 0.95)
+            summary["lease_acquire_max_s"] = acq[-1]
+        summary["lease_takeovers"] = len(takeovers)
+        hb = [float(r["heartbeat_age_s"]) for r in takeovers
+              if isinstance(r.get("heartbeat_age_s"), (int, float))]
+        if hb:
+            summary["lease_stale_heartbeat_max_s"] = max(hb)
+        summary["watchdog_trips"] = len(trips)
+        if trips:
+            summary["watchdog_trip_kinds"] = sorted(
+                {str(r.get("kind", "?")) for r in trips})
     return summary
 
 
@@ -282,6 +306,19 @@ def format_summary(s):
                    s["decode_intertoken_p95_s"],
                    s["decode_intertoken_p99_s"],
                    s.get("decode_step_p50_s", 0.0)))
+    if "lease_acquires" in s or "watchdog_trips" in s:
+        lines.append(
+            "  lease       %d acquires (p95 %.4fs)  %d takeovers%s"
+            % (s.get("lease_acquires", 0),
+               s.get("lease_acquire_p95_s", 0.0),
+               s.get("lease_takeovers", 0),
+               ("  stale heartbeat max %.1fs"
+                % s["lease_stale_heartbeat_max_s"]
+                if "lease_stale_heartbeat_max_s" in s else "")))
+        if s.get("watchdog_trips"):
+            lines.append("  watchdog    %d trips (%s)"
+                         % (s["watchdog_trips"],
+                            ", ".join(s.get("watchdog_trip_kinds", []))))
     return "\n".join(lines)
 
 
