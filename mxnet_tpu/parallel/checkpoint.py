@@ -15,20 +15,50 @@ Built on orbax (the JAX-ecosystem checkpoint library):
     mngr = ckpt.TrainerCheckpoint(dir, max_to_keep=3, async_save=True)
     mngr.save(step, trainer)           # non-blocking when async
     step = mngr.restore_latest(trainer)  # -> restored step or None
+
+Torn-checkpoint-proof resume (gang supervision, ISSUE 8): every
+completed save is sealed with a **commit manifest**
+(`<step>/mxtpu_commit.json`, written via `resilience.atomic_write`)
+carrying a per-file sha256/size map of the step directory. In a
+multi-rank gang the manifest is written only *after* the
+`commit_barrier` confirms every rank finished saving step S (two-phase
+commit: data first, atomic marker second), so a gang killed mid-save
+can never leave a step that looks complete. `restore_latest` refuses
+steps without a manifest (torn save) or whose checksums fail (silent
+corruption) and falls back to the previous committed step — counted in
+`checkpoint.rejected{reason}`. A directory with no manifests at all is
+a legacy checkpoint and keeps the old try-restore behavior.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import time
 import warnings
 
 import jax
 import numpy as _np
 
 from ..base import MXNetError, getenv
+from ..observability import registry as _obs
+from ..observability import telemetry as _tele
+from ..resilience.atomic import atomic_write
 from ..resilience.chaos import chaos_point
 from ..resilience.retry import RetryPolicy, TransientError, retry_call
 
-__all__ = ["TrainerCheckpoint"]
+__all__ = ["TrainerCheckpoint", "COMMIT_BASENAME"]
+
+COMMIT_BASENAME = "mxtpu_commit.json"
+
+COMMIT_SECONDS = _obs.histogram(
+    "checkpoint.commit.seconds",
+    "Wall time of one two-phase checkpoint commit (barrier + checksum "
+    "manifest + atomic marker)")
+REJECTED = _obs.counter(
+    "checkpoint.rejected",
+    "Checkpoint steps refused at restore time (label reason: "
+    "uncommitted / checksum)")
 
 
 def _state_of(trainer):
@@ -45,17 +75,49 @@ def _state_of(trainer):
 
 class TrainerCheckpoint:
     """Checkpoint manager for ShardedTrainer (params + aux + optimizer
-    state + step counter), sharded-aware and optionally async."""
+    state + step counter), sharded-aware and optionally async.
 
-    def __init__(self, directory, max_to_keep=None, async_save=False):
+    Gang-mode arguments (module docstring; docs/fault_tolerance.md):
+
+    `commit_barrier` — zero-arg callable run before the commit manifest
+    is written (`DistKVStore.barrier` in a gang): the two-phase-commit
+    guarantee that *every* rank finished saving step S. Setting it
+    forces synchronous commits (async deferral is disabled): the other
+    ranks mirror exactly one barrier per save, so the fence can never
+    be postponed or skipped without hanging them. `primary` —
+    only the primary rank writes manifests (non-primary managers are
+    restore-side readers). `single_host` — scope orbax's internal
+    coordination to THIS process even when `jax.process_count() > 1`:
+    in the gang layout rank 0 alone writes the (replicated) state, so
+    orbax must not wait on global barriers the other ranks never
+    enter."""
+
+    def __init__(self, directory, max_to_keep=None, async_save=False,
+                 commit_barrier=None, primary=True, single_host=False):
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self._dir = os.path.abspath(str(directory))
         os.makedirs(self._dir, exist_ok=True)
+        kwargs = {}
+        if single_host and jax.process_count() > 1:
+            from orbax.checkpoint import options as ocp_options
+            me = jax.process_index()
+            kwargs["multiprocessing_options"] = \
+                ocp_options.MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    barrier_sync_key_prefix="mxtpu_r%d" % me)
+            # orbax refuses create=True with active_processes; the
+            # makedirs above already created the root
+            kwargs["create"] = False
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
-            enable_async_checkpointing=bool(async_save))
+            enable_async_checkpointing=bool(async_save), **kwargs)
         self._mngr = ocp.CheckpointManager(self._dir, options=opts)
+        self._async = bool(async_save)
+        self._commit_barrier = commit_barrier
+        self._primary = bool(primary)
+        self._verify = getenv("MXTPU_CKPT_VERIFY", True)
+        self._pending = []   # saved steps whose commit marker is due
 
     def save(self, step, trainer, wait=False):
         """Write a checkpoint for `step`. With async_save=True this
@@ -79,8 +141,141 @@ class TrainerCheckpoint:
                 base_delay=getenv("MXTPU_RETRY_BASE_DELAY_S", 0.05),
                 retry_on=(TransientError,), what="checkpoint.save")
         retry_call(_attempt, policy=pol)
-        if wait:
+        # two-phase commit: orbax's save() waited for all PREVIOUS
+        # async work before starting this step, so every earlier
+        # pending step is fully on disk — seal it now. The step just
+        # saved commits immediately when the save was synchronous
+        # (wait=True or async off); an in-flight async step commits at
+        # the next save/wait/restore boundary.
+        prev, self._pending = self._pending, []
+        for s in prev:
+            self._commit(s)
+        # a commit_barrier forces synchronous commits: the barrier
+        # contract is that every rank mirrors EXACTLY ONE barrier per
+        # save, so the commit (and its barrier) can never be deferred
+        # to a later boundary or skipped — a deferred/conditional
+        # barrier would leave the other ranks' mirrored kv.barrier()
+        # calls waiting out their whole timeout on a fence rank 0
+        # never entered
+        if wait or not self._async or self._commit_barrier is not None:
             self._mngr.wait_until_finished()
+            self._commit(int(step))
+        else:
+            self._pending.append(int(step))
+
+    # -- two-phase commit ----------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self._dir, str(int(step)))
+
+    def _commit_path(self, step):
+        return os.path.join(self._step_dir(step), COMMIT_BASENAME)
+
+    @staticmethod
+    def _hash_tree(step_dir):
+        """Per-file sha256/size map of a finished step directory (the
+        commit manifest body). Relative paths, sorted, the manifest
+        file itself excluded."""
+        files = {}
+        for root, _dirs, names in os.walk(step_dir):
+            for name in sorted(names):
+                rel = os.path.relpath(os.path.join(root, name), step_dir)
+                if rel == COMMIT_BASENAME:
+                    continue
+                h = hashlib.sha256()
+                path = os.path.join(root, name)
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                files[rel] = {"sha256": h.hexdigest(),
+                              "bytes": os.path.getsize(path)}
+        return files
+
+    def _commit(self, step):
+        """Seal a fully-saved step: commit barrier (all ranks finished
+        saving S — the two-phase-commit fence), then the checksum
+        manifest written atomically by the primary rank. The barrier
+        runs UNCONDITIONALLY — the other ranks mirror it blindly, so
+        skipping it (e.g. for a step max_to_keep already pruned) would
+        desynchronize the gang; only the manifest write is gated on
+        the step directory still existing."""
+        t0 = time.perf_counter()
+        if self._commit_barrier is not None:
+            self._commit_barrier()
+        step_dir = self._step_dir(step)
+        if not os.path.isdir(step_dir):
+            return False
+        if self._primary and not os.path.exists(self._commit_path(step)):
+            files = self._hash_tree(step_dir)
+            manifest = {"step": int(step), "ts": time.time(),
+                        "world": int(jax.process_count()),
+                        "files": files}
+            with atomic_write(self._commit_path(step), "w") as f:
+                f.write(json.dumps(manifest, sort_keys=True))
+        dt = time.perf_counter() - t0
+        COMMIT_SECONDS.observe(dt)
+        _tele.emit({"ts": time.time(), "source": "resilience",
+                    "event": "ckpt_commit", "step": int(step),
+                    "step_time": dt})
+        return True
+
+    def commit_manifest(self, step):
+        """The step's commit manifest, or None (uncommitted/torn)."""
+        try:
+            with open(self._commit_path(step)) as f:
+                rec = json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+        return rec if isinstance(rec, dict) else None
+
+    def committed_steps(self):
+        return [s for s in self.all_steps()
+                if self.commit_manifest(s) is not None]
+
+    def _reject_reason(self, step, newest_committed=None,
+                       manifest=None):
+        """Why `step` must not be restored, or None when it is
+        restorable. A manifest-less step counts as TORN only when it
+        is newer than the newest committed step (saves are sequential,
+        so a torn save can have no committed successor); older
+        manifest-less steps predate two-phase commit (a mixed-history
+        directory) and keep the legacy try-restore behavior.
+        Verification reads every file back (skippable via
+        MXTPU_CKPT_VERIFY=0 for huge checkpoints where the commit
+        marker alone is trusted). `manifest` passes an already-loaded
+        manifest so restore_latest does not re-read each one."""
+        if manifest is None:
+            manifest = self.commit_manifest(step)
+        if manifest is None:
+            if newest_committed is not None and step > newest_committed:
+                REJECTED.inc(reason="uncommitted")
+                return ("no commit marker — the save was torn before "
+                        "all ranks finished")
+            return None    # legacy step (predates two-phase commit)
+        if not self._verify:
+            return None
+        step_dir = self._step_dir(step)
+        want = manifest.get("files", {})
+        try:
+            have = self._hash_tree(step_dir)
+        except OSError as err:
+            # files vanishing mid-verify: the primary rank is dropping
+            # this step concurrently (gang restore), or the disk is
+            # failing — either way the step is unusable
+            REJECTED.inc(reason="checksum")
+            return "unreadable during verification (%s)" % err
+        if want != have:
+            missing = sorted(set(want) - set(have))
+            extra = sorted(set(have) - set(want))
+            changed = sorted(k for k in set(want) & set(have)
+                             if want[k] != have[k])
+            REJECTED.inc(reason="checksum")
+            return ("checksum manifest mismatch: %d missing, %d "
+                    "changed, %d unexpected file(s)%s"
+                    % (len(missing), len(changed), len(extra),
+                       ((" — first: %r"
+                         % (missing + changed + extra)[0])
+                        if (missing or changed or extra) else "")))
+        return None
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())
@@ -223,43 +418,103 @@ class TrainerCheckpoint:
         return out
 
     def restore_latest(self, trainer):
-        """Restore the newest *readable* checkpoint; returns its step or
-        None when the directory holds no steps.
+        """Restore the newest *complete, readable* checkpoint; returns
+        its step or None when the directory holds no steps.
 
-        A preempted save or disk corruption can leave the newest step
-        unreadable; dying on it would strand a run whose older steps
-        are fine. Each failing step is skipped with a RuntimeWarning
-        naming it and the error; only when every step fails does the
-        last error propagate wrapped in a diagnosable MXNetError.
-        `restore(step, ...)` keeps strict single-step semantics —
-        restore() mutates the trainer only after full validation, so a
-        failed candidate leaves it untouched for the next one."""
+        A gang killed mid-save, a preempted writer, or disk corruption
+        can leave the newest step torn; dying on it — or worse,
+        resuming from half of it — would strand the run. Steps without
+        a commit manifest (the save never finished on every rank) or
+        whose checksums fail are *rejected* (`checkpoint.rejected`),
+        and unreadable steps are skipped, each with a RuntimeWarning
+        naming it; only when every step fails does the last error
+        propagate wrapped in a diagnosable MXNetError. A directory
+        with no manifests at all predates two-phase commit and keeps
+        the old try-restore behavior. `restore(step, ...)` keeps
+        strict single-step semantics — restore() mutates the trainer
+        only after full validation, so a failed candidate leaves it
+        untouched for the next one."""
+        self._finalize_pending()
         steps = sorted(self._mngr.all_steps(), reverse=True)
         if not steps:
             return None
+        # legacy directories (no manifest anywhere) keep working; with
+        # committed steps present, only steps NEWER than the newest
+        # committed one can be torn saves — older manifest-less steps
+        # are pre-upgrade history and stay restorable
+        manifests = {s: self.commit_manifest(s) for s in steps}
+        committed = [s for s in steps if manifests[s] is not None]
+        newest_committed = max(committed) if committed else None
         last_err = None
         for i, step in enumerate(steps):
+            if committed:
+                reason = self._reject_reason(step, newest_committed,
+                                             manifest=manifests[step])
+                if reason is not None:
+                    last_err = MXNetError(
+                        "checkpoint step %d rejected: %s"
+                        % (step, reason))
+                    self._warn_fallback(step, steps, i, reason)
+                    # drop the unusable step (primary rank only): the
+                    # resumed run re-trains and RE-SAVES this very step
+                    # number, and a torn corpse left in place would
+                    # make that save raise StepAlreadyExistsError —
+                    # turning recovery into a restart-budget-eating
+                    # crash loop
+                    if self._primary:
+                        self._drop_step(step)
+                    continue
             try:
                 return self.restore(step, trainer)
             except Exception as err:  # noqa: BLE001 — any unreadable
                 # step (truncated array file, torn metadata, orbax
                 # format error) falls through to the next-newest
                 last_err = err
-                if i + 1 < len(steps):
-                    warnings.warn(
-                        "checkpoint step %d in %s is unreadable (%s: "
-                        "%s); falling back to step %d"
-                        % (step, self._dir, type(err).__name__, err,
-                           steps[i + 1]), RuntimeWarning)
+                self._warn_fallback(step, steps, i, "%s: %s"
+                                    % (type(err).__name__, err))
         raise MXNetError(
-            "no readable checkpoint among steps %s in %s"
+            "no complete readable checkpoint among steps %s in %s"
             % (sorted(steps), self._dir)) from last_err
+
+    def _drop_step(self, step):
+        """Remove a rejected (torn/corrupt) step from disk and from
+        orbax's step cache. Best-effort: a failure to delete only
+        resurfaces as the StepAlreadyExists crash this prevents."""
+        try:
+            self._mngr.delete(int(step))
+            return
+        except Exception:   # noqa: BLE001 — fall through to raw rm
+            pass
+        import shutil
+        shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    def _warn_fallback(self, step, steps, i, why):
+        if i + 1 < len(steps):
+            warnings.warn(
+                "checkpoint step %d in %s is unreadable (%s); falling "
+                "back to step %d"
+                % (step, self._dir, why, steps[i + 1]), RuntimeWarning)
+
+    def _finalize_pending(self):
+        """Commit every step whose async save has finished (called from
+        the wait/restore/close boundaries — the moments the caller
+        synchronizes with the manager anyway)."""
+        if not self._pending:
+            return
+        self._mngr.wait_until_finished()
+        pending, self._pending = self._pending, []
+        for s in pending:
+            self._commit(s)
 
     def wait_until_finished(self):
         self._mngr.wait_until_finished()
+        self._finalize_pending()
 
     def close(self):
-        self._mngr.close()
+        try:
+            self._finalize_pending()
+        finally:
+            self._mngr.close()
 
     def __enter__(self):
         return self

@@ -27,7 +27,14 @@ missing machinery, wired through the runtime at named sites:
              accelerator for bench/serving/training; ISSUE 7).
 - `watchdog`: `HealthWatchdog` / `DeviceUnreachable` — deadline-bounded
              device init and hung-collective monitoring with holder
-             diagnostics on trip.
+             diagnostics on trip (and, in a supervised gang, peer
+             heartbeat polling while a collective waits).
+- `supervisor`: elastic gang supervision (ISSUE 8) — `GangSupervisor`
+             spawns/adopts an N-rank gang, tears down stragglers on
+             any rank death, and relaunches from the latest committed
+             checkpoint with bounded restarts; `RankHeartbeat` +
+             `PeerLost` give survivors seconds-level dead-peer
+             detection instead of a full watchdog timeout.
 - `metrics`: process-wide counters (injected faults, skipped corrupt
              records) surfaced for monitoring.
 """
@@ -40,6 +47,8 @@ from .preempt import (PreemptionGuard, TrainingPreempted,
 from .atomic import atomic_write, exclusive_create
 from .lease import DeviceLease, LeaseHeld
 from .watchdog import DeviceUnreachable, HealthWatchdog
+from .supervisor import (GangSupervisor, PeerLost, RankHeartbeat,
+                         run_supervised, EXIT_PREEMPTED, EXIT_PEER_LOST)
 from . import metrics
 from .metrics import counters
 
@@ -50,4 +59,6 @@ __all__ = ["RetryPolicy", "retry", "retry_call", "Deadline",
            "PreemptionGuard", "TrainingPreempted", "at_step_boundary",
            "preemption_requested", "atomic_write", "exclusive_create",
            "DeviceLease", "LeaseHeld", "DeviceUnreachable",
-           "HealthWatchdog", "metrics", "counters"]
+           "HealthWatchdog", "GangSupervisor", "PeerLost",
+           "RankHeartbeat", "run_supervised", "EXIT_PREEMPTED",
+           "EXIT_PEER_LOST", "metrics", "counters"]

@@ -10,7 +10,10 @@ Fields per site:
   p      probability a draw trips the fault            (default 1.0)
   kind   raise  -> InjectedFault (a TransientError: retry-safe)
          fatal  -> InjectedFailure (never retried)
-         sleep  -> time.sleep(secs) (exercises deadlines)  (default raise)
+         sleep  -> time.sleep(secs) (exercises deadlines)
+         kill   -> SIGKILL this process (the rank-death chaos mode —
+                   no cleanup, no atexit: exactly what a preempted VM
+                   or an OOM kill looks like to the gang) (default raise)
   secs   sleep duration for kind=sleep                 (default 0.1)
   n      stop tripping after n faults                  (default unlimited)
   after  skip the first `after` draws                  (default 0)
@@ -20,8 +23,19 @@ deterministic: each site gets its own `random.Random` seeded from
 MXTPU_CHAOS_SEED (default 0) and the site name, so a chaos run replays
 bit-identically across processes and reruns.
 
+Per-rank arming: a distributed worker merges
+``MXTPU_CHAOS_RANK_<rank>`` (rank from JAX_PROCESS_ID /
+DMLC_WORKER_ID) into the global spec, per-rank entries winning on a
+site collision — the tools/chaos_run.py ``--kill-rank`` plumbing: one
+env block reaches the whole gang but only the targeted rank arms the
+extra sites. A GangSupervisor strips these variables from relaunched
+generations (an injected incident happens once;
+docs/fault_tolerance.md).
+
 Injection sites wired through the runtime: `kvstore.push`, `dist.init`,
-`checkpoint.save`, `io.read`, `engine.host_push`, `serving.infer`,
+`checkpoint.save`, `io.read`, `worker.kill` (fires at every training
+step boundary — `resilience.preempt.at_step_boundary` — so `kind=kill`
+kills a rank mid-run), `engine.host_push`, `serving.infer`,
 `serving.decode` (fires before every continuous-batching decode step;
 kind=sleep stretches steps so deadline eviction can be exercised,
 kind=raise fails every in-flight sequence), `lease.acquire` (before a
@@ -34,6 +48,7 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import threading
 import time
 
@@ -56,7 +71,9 @@ class InjectedFailure(MXNetError):
 
 
 _FIELDS = {"p": float, "secs": float, "n": int, "after": int, "kind": str}
-_KINDS = ("raise", "fatal", "sleep")
+_KINDS = ("raise", "fatal", "sleep", "kill")
+
+_KILL = object()   # decide() verdict sentinel for kind=kill
 
 
 def parse_spec(spec):
@@ -120,6 +137,8 @@ class _Site:
         metrics.bump("chaos.injected.%s" % at_site)
         if self.kind == "sleep":
             return self.secs
+        if self.kind == "kill":
+            return _KILL
         cls = InjectedFailure if self.kind == "fatal" else InjectedFault
         return cls("[chaos] injected %s fault at %r (trip %d, draw %d, "
                    "spec site %r)" % (self.kind, at_site, self.trips,
@@ -131,11 +150,32 @@ _lock = threading.Lock()
 _state = {"exact": None, "prefix": []}
 
 
+def _rank_spec():
+    """The per-rank spec for this process, or "". A distributed worker
+    arms MXTPU_CHAOS_RANK_<its rank> (rank from the standard
+    rendezvous env) IN ADDITION to any global MXTPU_CHAOS, so a single
+    env block can target one rank of a gang; same-site entries in the
+    rank spec override the global ones (later entries win)."""
+    rank = os.environ.get("JAX_PROCESS_ID") or \
+        os.environ.get("DMLC_WORKER_ID")
+    if rank is None:
+        return ""
+    try:
+        rank = int(rank)
+    except ValueError:
+        return ""
+    return os.environ.get("MXTPU_CHAOS_RANK_%d" % rank, "")
+
+
 def configure(spec=None, seed=None):
     """Arm the injector programmatically (tests) or from the env
-    (spec=None re-reads MXTPU_CHAOS). An empty spec disarms."""
+    (spec=None reads MXTPU_CHAOS merged with this rank's
+    MXTPU_CHAOS_RANK_<r> — the per-rank entries win on a site
+    collision, so a global spec can never silently mask a targeted
+    rank kill). An empty spec disarms."""
     if spec is None:
-        spec = os.environ.get("MXTPU_CHAOS", "")
+        spec = ";".join(filter(None, [os.environ.get("MXTPU_CHAOS", ""),
+                                      _rank_spec()]))
     if seed is None:
         seed = getenv("MXTPU_CHAOS_SEED", 0)
     parsed = parse_spec(spec)
@@ -182,6 +222,11 @@ def chaos_point(site):
         verdict = sp.decide(site)
     if verdict is None:
         return
+    if verdict is _KILL:
+        # the rank-death mode: no unwinding, no atexit, no flushing —
+        # what a preempted VM or the OOM killer looks like to the gang
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # pragma: no cover — unreachable
     if isinstance(verdict, float):
         time.sleep(verdict)
         return

@@ -25,6 +25,7 @@ from __future__ import annotations
 import signal
 
 from ..base import MXNetError
+from .chaos import chaos_point
 
 __all__ = ["TrainingPreempted", "PreemptionGuard", "at_step_boundary",
            "preemption_requested"]
@@ -33,7 +34,15 @@ __all__ = ["TrainingPreempted", "PreemptionGuard", "at_step_boundary",
 class TrainingPreempted(MXNetError):
     """Raised at a step boundary after a preemption signal; `.step` is
     the step the final synchronous checkpoint captured (None when the
-    guard had no save callback)."""
+    guard had no save callback).
+
+    `.exit_code` (75) is the gang exit-code contract
+    (resilience/supervisor.py): a preempted worker exits 75 so a
+    GangSupervisor can tell external eviction (stop — the host is
+    going away) from a crash (restart) and from a lost peer (76)
+    without parsing stderr."""
+
+    exit_code = 75
 
     def __init__(self, msg, step=None):
         super().__init__(msg)
@@ -69,7 +78,13 @@ def preemption_requested():
 def at_step_boundary():
     """Called by the training loops between optimizer steps. No-op
     (one dict read) unless a PreemptionGuard is active and a signal
-    arrived; then the innermost guard saves and raises."""
+    arrived; then the innermost guard saves and raises.
+
+    Also the `worker.kill` chaos site: `kind=kill` SIGKILLs this rank
+    mid-run — the gang-supervision proof (a dead rank must yield fast
+    peer detection, supervisor teardown, and a committed-checkpoint
+    resume, docs/fault_tolerance.md)."""
+    chaos_point("worker.kill")
     sig = _requested["sig"]
     if sig is None or not _guards:
         return

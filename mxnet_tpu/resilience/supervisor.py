@@ -1,0 +1,769 @@
+"""Elastic gang supervision: peer-failure detection and coordinated
+restart for distributed training (docs/fault_tolerance.md).
+
+The reference's recovery model is "restart from checkpoint" (SURVEY.md
+§5.3–5.4) and both PAPERS.md systems treat worker failure as a runtime
+event to be survived, not a job-ending accident: the parameter-server
+design relaunches lost nodes against replicated state, and TensorFlow
+makes checkpoint-based gang restart the production recovery path. Our
+pieces existed (PreemptionGuard, sharded checkpoints with fallback, the
+ISSUE-7 lease/watchdogs) but the loop was open: a rank dying mid-run
+left the survivors blocked in a collective until the watchdog's full
+budget expired, and then the job was simply dead. This module closes
+the loop:
+
+* **`RankHeartbeat`** — each rank of a gang writes a per-rank heartbeat
+  file (`<gang_dir>/rank_<r>.hb`, refreshed by a daemon thread via
+  `resilience.atomic.atomic_write`) carrying the same identity record
+  the device lease uses (pid / boot_id / /proc starttime — the
+  pid-reuse defense). A reader can prove a peer DEAD the instant its
+  pid is gone, without waiting out any timeout.
+* **`PeerLost`** — the typed error survivors raise instead of a generic
+  `DeadlineExceeded`: `.rank` names the dead peer. `DistKVStore`
+  collectives and `barrier` poll peer heartbeats while they wait
+  (`HealthWatchdog.guard_collective(peer_check=...)`), so a SIGKILLed
+  peer is detected in seconds, not after the collective watchdog's
+  whole budget.
+* **`GangSupervisor`** — spawns (or adopts) the N-rank process gang,
+  watches per-rank liveness, and on any rank death tears down the
+  stragglers cleanly (they would only hang on the next collective),
+  then relaunches the gang from the latest *complete* checkpoint with
+  bounded restarts and exponential backoff (`MXTPU_MAX_RESTARTS`,
+  `MXTPU_RESTART_BACKOFF_S`). Restart counts and per-incident downtime
+  are surfaced as metrics, telemetry events, and a `report()` dict
+  (also written to `<gang_dir>/report.json`).
+
+Exit-code contract (restart-vs-stop without parsing stderr):
+
+  ==============  ====  =====================================
+  outcome         code  supervisor decision
+  ==============  ====  =====================================
+  clean finish       0  gang done; no restart
+  preempted         75  external eviction: STOP (the host is
+                        going away; a relaunch is futile here)
+  peer lost         76  survivor of a gang failure: expected
+                        collateral, never the root cause
+  anything else    any  crash: teardown + restart (bounded)
+  ==============  ====  =====================================
+
+`TrainingPreempted.exit_code` / `PeerLost.exit_code` carry the codes;
+`run_supervised(fn)` is the worker-side shim that maps the exceptions
+onto them.
+
+Env knobs (docs/fault_tolerance.md):
+  MXTPU_GANG_DIR           gang state dir (set by the supervisor for
+                           its children; presence = supervised mode)
+  MXTPU_GANG_HEARTBEAT_S   rank heartbeat refresh interval (1.0)
+  MXTPU_GANG_PEER_TIMEOUT_S  heartbeat age past which a live-pid peer
+                           counts as wedged-dead (15)
+  MXTPU_MAX_RESTARTS       gang relaunches before giving up (3)
+  MXTPU_RESTART_BACKOFF_S  first restart backoff, doubled per
+                           incident, capped at 60 (1.0)
+  MXTPU_GANG_KILL_GRACE_S  straggler SIGTERM->SIGKILL grace (10)
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ..base import MXNetError, getenv
+from ..observability import registry as _obs
+from ..observability import telemetry as _tele
+from .atomic import atomic_write
+from .lease import (_boot_id, _heartbeat_age, _holder_alive,
+                    _proc_starttime)
+from .preempt import TrainingPreempted
+
+__all__ = ["PeerLost", "RankHeartbeat", "GangSupervisor", "gang_dir",
+           "ensure_rank_heartbeat", "read_heartbeat", "peer_status",
+           "dead_peers", "peer_checker", "run_supervised",
+           "exit_status", "EXIT_PREEMPTED", "EXIT_PEER_LOST"]
+
+EXIT_PREEMPTED = TrainingPreempted.exit_code   # 75 (preempt.py)
+EXIT_PEER_LOST = 76
+
+RESTARTS = _obs.counter(
+    "resilience.supervisor.restarts",
+    "Gang relaunches performed by a GangSupervisor")
+DOWNTIME = _obs.histogram(
+    "resilience.supervisor.downtime.seconds",
+    "Per-incident downtime: first rank-failure detection to the gang "
+    "running again")
+HB_AGE = _obs.gauge(
+    "resilience.supervisor.rank.heartbeat.age",
+    "Last observed per-rank heartbeat age in seconds (label rank)")
+
+_log = None
+
+
+def _logger():
+    global _log
+    if _log is None:
+        from ..log import get_logger
+        _log = get_logger("mxnet_tpu.resilience")
+    return _log
+
+
+class PeerLost(MXNetError):
+    """A gang peer is provably dead (pid gone / recycled / previous
+    boot) or silent past the heartbeat timeout while this rank waited
+    in a collective. `.rank` names the dead peer — the diagnosable
+    replacement for a generic `DeadlineExceeded` after the full
+    collective-watchdog budget."""
+
+    exit_code = EXIT_PEER_LOST
+
+    def __init__(self, msg, rank=None):
+        super().__init__(msg)
+        self.rank = rank
+
+
+# -- gang identity -------------------------------------------------------
+
+def gang_dir():
+    """The gang state directory, or None when this process is not part
+    of a supervised gang. The supervisor exports MXTPU_GANG_DIR to its
+    children; its presence is how the runtime knows to start a rank
+    heartbeat and arm peer checks."""
+    return os.environ.get("MXTPU_GANG_DIR") or None
+
+
+def _hb_path(directory, rank):
+    return os.path.join(directory, "rank_%d.hb" % int(rank))
+
+
+def _supervisor_path(directory):
+    return os.path.join(directory, "supervisor.json")
+
+
+def read_heartbeat(path):
+    """The heartbeat record at `path`, or None (absent/torn file —
+    atomic_write makes torn impossible from our writers, but a foreign
+    writer or a dying filesystem still yields None, never garbage)."""
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+# A heartbeat record IS a lease-file identity record (pid / boot_id /
+# /proc starttime), so peer liveness and heartbeat age reuse the lease
+# layer's checks verbatim — one pid-reuse defense, not three.
+_identity_alive = _holder_alive
+_hb_age = _heartbeat_age
+
+
+class RankHeartbeat:
+    """One rank's liveness beacon: a JSON identity record refreshed by
+    a daemon thread every `MXTPU_GANG_HEARTBEAT_S` via `atomic_write`
+    (readers never see a torn record). Cheap enough to run always when
+    `MXTPU_GANG_DIR` is set: one small file write per second."""
+
+    def __init__(self, rank, directory=None, interval_s=None):
+        self.rank = int(rank)
+        self.directory = directory or gang_dir()
+        if self.directory is None:
+            raise MXNetError("RankHeartbeat needs a gang directory "
+                             "(MXTPU_GANG_DIR unset)")
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else getenv("MXTPU_GANG_HEARTBEAT_S", 1.0))
+        self.path = _hb_path(self.directory, self.rank)
+        self.step = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _record(self):
+        pid = os.getpid()
+        rec = {"rank": self.rank, "pid": pid,
+               "host": socket.gethostname(), "boot_id": _boot_id(),
+               "starttime": _proc_starttime(pid),
+               "created": getattr(self, "_created", None) or time.time(),
+               "heartbeat": time.time(),
+               "interval_s": self.interval_s}
+        if self.step is not None:
+            rec["step"] = int(self.step)
+        return rec
+
+    def beat(self, step=None):
+        """One heartbeat write (the daemon thread's body; callable
+        synchronously from a training loop to piggyback step info)."""
+        if step is not None:
+            self.step = int(step)
+        rec = self._record()
+        if not hasattr(self, "_created"):
+            self._created = rec["created"]
+            rec["created"] = self._created
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with atomic_write(self.path, "w") as f:
+                f.write(json.dumps(rec, sort_keys=True))
+        except OSError:
+            return False
+        return True
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self.beat()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="gang-heartbeat:r%d" % self.rank)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self, unlink=False):
+        self._stop.set()
+        th = self._thread
+        if th is not None and th is not threading.current_thread():
+            th.join(timeout=2.0 * self.interval_s + 1.0)
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+_process_hb = {"hb": None, "atexit": False}
+_process_hb_lock = threading.Lock()
+
+
+def stop_rank_heartbeat(unlink=True):
+    """Stop the process-wide rank heartbeat; with `unlink` the beacon
+    file is removed, telling peers this rank LEFT cleanly. Crucial at
+    clean exit: a finished rank's stale record with a now-dead pid
+    would otherwise read as 'provably dead' to a peer still inside its
+    final collective, turning a successful run into a spurious
+    PeerLost + pointless gang restart. Registered via atexit (so plain
+    `sys.exit` covers it); a SIGKILLed/crashed rank never runs it —
+    exactly then the lingering record is the evidence peers need."""
+    with _process_hb_lock:
+        hb, _process_hb["hb"] = _process_hb["hb"], None
+    if hb is not None:
+        hb.stop(unlink=unlink)
+
+
+def ensure_rank_heartbeat(rank, directory=None):
+    """Start (or adopt) the process-wide rank heartbeat. Called from
+    `init_distributed` once the rank is known; idempotent — later
+    callers ride the running beacon. Returns None when no gang
+    directory is configured (unsupervised run)."""
+    directory = directory or gang_dir()
+    if directory is None:
+        return None
+    with _process_hb_lock:
+        hb = _process_hb["hb"]
+        if hb is not None and hb.rank == int(rank) \
+                and hb.directory == directory:
+            return hb
+        if hb is not None:
+            hb.stop()
+        hb = RankHeartbeat(rank, directory)
+        hb.start()
+        _process_hb["hb"] = hb
+        if not _process_hb["atexit"]:
+            import atexit
+
+            # atexit runs for BOTH clean exits and unhandled-exception
+            # deaths; only the clean path may unlink — a crashed
+            # rank's lingering record (dead pid) is the very evidence
+            # peers need for seconds-level PeerLost detection. An
+            # excepthook wrapper marks the crash before atexit fires.
+            prev_hook = sys.excepthook
+
+            def _mark_crashed(*exc_info):
+                _process_hb["crashed"] = True
+                return prev_hook(*exc_info)
+
+            sys.excepthook = _mark_crashed
+            atexit.register(lambda: stop_rank_heartbeat(
+                unlink=not _process_hb.get("crashed")))
+            _process_hb["atexit"] = True
+        return hb
+
+
+# -- peer-failure detection ---------------------------------------------
+
+def peer_status(directory=None, exclude_rank=None):
+    """Snapshot every rank heartbeat in the gang dir: a list of dicts
+    with rank / heartbeat age / alive (identity check). Feeds the
+    `resilience.supervisor.rank.heartbeat.age` gauge and the dead-peer
+    verdicts below."""
+    directory = directory or gang_dir()
+    out = []
+    if directory is None:
+        return out
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return out
+    for name in sorted(entries):
+        if not (name.startswith("rank_") and name.endswith(".hb")):
+            continue
+        try:
+            rank = int(name[len("rank_"):-len(".hb")])
+        except ValueError:
+            continue
+        if exclude_rank is not None and rank == int(exclude_rank):
+            continue
+        rec = read_heartbeat(os.path.join(directory, name))
+        if rec is None:
+            continue
+        age = _hb_age(rec)
+        alive = _identity_alive(rec)
+        HB_AGE.set(age, rank=str(rank))
+        out.append({"rank": rank, "age_s": age, "alive": alive,
+                    "pid": rec.get("pid"), "step": rec.get("step")})
+    return out
+
+
+def dead_peers(directory=None, exclude_rank=None, timeout_s=None):
+    """Ranks that are provably dead (identity check failed: gone pid,
+    recycled pid, previous boot — detected within one poll, no timeout
+    involved) or wedged-dead (live pid, heartbeat silent past
+    `MXTPU_GANG_PEER_TIMEOUT_S`). Returns [(rank, reason), ...]."""
+    if timeout_s is None:
+        timeout_s = getenv("MXTPU_GANG_PEER_TIMEOUT_S", 15.0)
+    timeout_s = float(timeout_s)
+    out = []
+    for st in peer_status(directory, exclude_rank=exclude_rank):
+        if not st["alive"]:
+            out.append((st["rank"],
+                        "pid %s is gone (heartbeat %.1fs ago)"
+                        % (st["pid"], st["age_s"])))
+        elif st["age_s"] > timeout_s:
+            out.append((st["rank"],
+                        "heartbeat silent for %.1fs (timeout %.6gs, "
+                        "pid %s still present)"
+                        % (st["age_s"], timeout_s, st["pid"])))
+    return out
+
+
+def peer_checker(exclude_rank=None, directory=None, timeout_s=None,
+                 what="collective"):
+    """Build the `peer_check` callable `HealthWatchdog` polls while a
+    collective waits: raises `PeerLost` naming the first dead rank.
+    Emits the `rank_lost` telemetry event so a failed round is
+    diagnosable from the stream alone. Returns None when no gang dir
+    is configured (nothing to check — keeps call sites branch-free)."""
+    directory = directory or gang_dir()
+    if directory is None:
+        return None
+
+    def check():
+        dead = dead_peers(directory, exclude_rank=exclude_rank,
+                          timeout_s=timeout_s)
+        if not dead:
+            return
+        rank, reason = dead[0]
+        _tele.emit({"ts": time.time(), "source": "resilience",
+                    "event": "rank_lost", "rank": rank,
+                    "reason": reason, "step_time": 0.0,
+                    "observer_rank": exclude_rank})
+        raise PeerLost(
+            "gang peer rank %d is lost while this rank waited in a %s: "
+            "%s — aborting instead of waiting out the collective "
+            "watchdog (docs/fault_tolerance.md)"
+            % (rank, what, reason), rank=rank)
+
+    return check
+
+
+# -- worker-side exit-code contract -------------------------------------
+
+def exit_status(err):
+    """The process exit code for a training-loop exception: the typed
+    resilience errors carry `.exit_code` (preempted 75, peer lost 76);
+    anything else is a crash (1)."""
+    return int(getattr(err, "exit_code", 1))
+
+
+def run_supervised(fn):
+    """Worker-side shim: run `fn()` and map the typed resilience
+    exceptions onto the gang exit-code contract so the supervisor can
+    decide restart-vs-stop without parsing stderr.
+
+    `PeerLost` exits via `os._exit`: the dead collective is still
+    blocked on a daemon thread and the coordinator may be gone, so a
+    polite interpreter teardown (jax's distributed shutdown, atexit
+    hooks) can itself hang — the process state is suspect and the
+    supervisor is about to rebuild it anyway. On a clean return the
+    rank heartbeat is unlinked FIRST, so peers still draining their
+    final collective never mistake this finished rank for a dead
+    one."""
+    try:
+        result = fn()
+        stop_rank_heartbeat(unlink=True)
+        return result
+    except TrainingPreempted as err:
+        print("run_supervised: %s" % err, file=sys.stderr, flush=True)
+        sys.exit(exit_status(err))
+    except PeerLost as err:
+        print("run_supervised: %s" % err, file=sys.stderr, flush=True)
+        sys.stdout.flush()
+        os._exit(exit_status(err))
+
+
+# -- the supervisor ------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pump(prefix, stream, out):
+    for line in iter(stream.readline, b""):
+        out.write("%s%s" % (prefix, line.decode(errors="replace")))
+        out.flush()
+
+
+class GangSupervisor:
+    """Spawn/adopt an N-rank gang, watch per-rank liveness, and keep it
+    running through rank failures (module docstring).
+
+    `command` is the per-rank argv; every rank gets the standard
+    rendezvous env (JAX_* / DMLC_*, the tools/launch.py contract) plus
+    `MXTPU_GANG_DIR` / `MXTPU_SUPERVISED=1`. `rank_env` maps rank ->
+    extra env applied to **generation 0 only**, and `MXTPU_CHAOS_RANK_*`
+    variables (the tools/chaos_run.py --kill-rank plumbing, inherited
+    through `base_env`) are likewise stripped from every generation
+    after the first: an injected incident happens once; replaying it
+    into every relaunched generation would make recovery untestable.
+    """
+
+    def __init__(self, command, nranks, gang_dir=None, base_env=None,
+                 rank_env=None, max_restarts=None, backoff_s=None,
+                 kill_grace_s=None, poll_s=0.25, out=None):
+        self.command = list(command)
+        self.nranks = int(nranks)
+        self.dir = os.path.abspath(gang_dir) if gang_dir else \
+            os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                         "mxtpu_gang_%d_%d" % (os.getuid(), os.getpid()))
+        self.base_env = dict(base_env if base_env is not None
+                             else os.environ)
+        self.rank_env = {int(r): dict(e)
+                         for r, e in (rank_env or {}).items()}
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else getenv("MXTPU_MAX_RESTARTS", 3))
+        self.backoff_s = float(
+            backoff_s if backoff_s is not None
+            else getenv("MXTPU_RESTART_BACKOFF_S", 1.0))
+        self.kill_grace_s = float(
+            kill_grace_s if kill_grace_s is not None
+            else getenv("MXTPU_GANG_KILL_GRACE_S", 10.0))
+        self.poll_s = float(poll_s)
+        self.out = out if out is not None else sys.stdout
+        self.generation = 0
+        self.restarts = 0
+        self.incidents = []
+        self.procs = []
+        self._pumps = []
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+
+    # -- supervisor identity record (what kill_stale reads) ------------
+    def _write_record(self):
+        pid = os.getpid()
+        rec = {"what": "gang-supervisor", "pid": pid,
+               "host": socket.gethostname(), "boot_id": _boot_id(),
+               "starttime": _proc_starttime(pid),
+               "nranks": self.nranks, "generation": self.generation,
+               "restarts": self.restarts,
+               "created": getattr(self, "_created", None) or time.time(),
+               "heartbeat": time.time(),
+               "cmdline": " ".join(self.command)[:200]}
+        if not hasattr(self, "_created"):
+            self._created = rec["created"]
+        try:
+            with atomic_write(_supervisor_path(self.dir), "w") as f:
+                f.write(json.dumps(rec, sort_keys=True))
+        except OSError:
+            pass
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(1.0):
+            self._write_record()
+
+    def _ensure_heartbeat_thread(self):
+        if self._hb_thread is None:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="gang-supervisor-heartbeat")
+            self._hb_thread.start()
+
+    # -- spawning ------------------------------------------------------
+    # The JAX_*/DMLC_* rendezvous block, _free_port, and the output
+    # pump mirror tools/launch.py's local launcher on purpose: the
+    # tool must stay stdlib-importable for its plain -n mode (the
+    # kill_stale/lease precedent), so the contract is duplicated —
+    # change BOTH or supervised and plain launches will diverge.
+    def _rank_environ(self, coordinator, rank):
+        env = dict(self.base_env)
+        if self.generation > 0:
+            # one-shot injected incidents: never replay a chaos kill
+            # into the recovered gang (the restart would loop forever)
+            for key in [k for k in env
+                        if k.startswith("MXTPU_CHAOS_RANK_")]:
+                env.pop(key)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(self.nranks),
+            "DMLC_WORKER_ID": str(rank),
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(self.nranks),
+            "JAX_PROCESS_ID": str(rank),
+            "MXTPU_GANG_DIR": self.dir,
+            "MXTPU_SUPERVISED": "1",
+            "MXTPU_GANG_GENERATION": str(self.generation),
+        })
+        if self.generation == 0:
+            env.update(self.rank_env.get(rank, {}))
+        return env
+
+    def spawn(self):
+        """Start one gang generation: fresh coordinator port, cleared
+        rank heartbeats (a dead previous generation's records must not
+        trigger instant PeerLost in the new one), N children."""
+        os.makedirs(self.dir, exist_ok=True)
+        try:
+            stale = [n for n in os.listdir(self.dir)
+                     if n.startswith("rank_") and n.endswith(".hb")]
+        except OSError:
+            stale = []
+        for name in stale:
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        self._write_record()
+        self._ensure_heartbeat_thread()
+        coordinator = "127.0.0.1:%d" % _free_port()
+        self.procs = []
+        for rank in range(self.nranks):
+            p = subprocess.Popen(
+                self.command,
+                env=self._rank_environ(coordinator, rank),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            t = threading.Thread(
+                target=_pump, args=("[%d] " % rank, p.stdout, self.out),
+                daemon=True)
+            t.start()
+            self.procs.append(p)
+            self._pumps.append(t)
+        _logger().info(
+            "gang generation %d: %d ranks spawned (coordinator %s, "
+            "gang dir %s)", self.generation, self.nranks, coordinator,
+            self.dir)
+        return self.procs
+
+    def adopt(self, procs):
+        """Adopt an already-spawned generation (the caller launched the
+        ranks itself — e.g. an external launcher): liveness watching,
+        teardown, and restart all apply; only the first spawn is the
+        caller's."""
+        if len(procs) != self.nranks:
+            raise MXNetError("adopt() got %d processes for an %d-rank "
+                             "gang" % (len(procs), self.nranks))
+        os.makedirs(self.dir, exist_ok=True)
+        self._write_record()
+        self._ensure_heartbeat_thread()
+        self.procs = list(procs)
+        return self.procs
+
+    # -- teardown ------------------------------------------------------
+    def _teardown(self):
+        """Stop every still-running rank: SIGTERM, grace, SIGKILL.
+        Returns the final {rank: returncode} map for the generation."""
+        alive = [p for p in self.procs if p.poll() is None]
+        for p in alive:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + max(0.2, self.kill_grace_s)
+        for p in alive:
+            remaining = deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(0.05, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()
+        return {r: p.returncode for r, p in enumerate(self.procs)}
+
+    # -- the supervision loop ------------------------------------------
+    def run(self, procs=None):
+        """Supervise until the gang finishes cleanly, is preempted, or
+        exhausts its restart budget. Returns the gang's exit code (0 /
+        EXIT_PREEMPTED / first failing rank's code)."""
+        if procs is not None:
+            self.adopt(procs)
+        elif not self.procs:
+            self.spawn()
+        try:
+            return self._run_loop()
+        finally:
+            self._hb_stop.set()
+            self._write_report()
+
+    def _run_loop(self):
+        while True:
+            failed = self._watch_generation()
+            if failed is None:
+                return 0                        # every rank exited 0
+            rank, rc = failed
+            wedged = False
+            if rc == EXIT_PEER_LOST:
+                # only survivors' collateral exits observed: the true
+                # root cause is a WEDGED peer (alive pid, silent
+                # heartbeat — it never exits on its own); ask the
+                # heartbeats who was actually lost. Peers that exited
+                # with collateral codes themselves (their un-unlinked
+                # heartbeat files also read as dead) can never be the
+                # root cause — prefer the still-running wedged rank.
+                cands = []
+                for drank, _reason in dead_peers(self.dir):
+                    if not (0 <= drank < self.nranks) or drank == rank:
+                        continue
+                    drc = self.procs[drank].poll()
+                    if drc in (EXIT_PEER_LOST, EXIT_PREEMPTED):
+                        continue
+                    cands.append((drank, drc))
+                cands.sort(key=lambda c: (c[1] is not None, c[0]))
+                if cands:
+                    rank, rc = cands[0]
+                    wedged = rc is None
+            # the restart-vs-stop decision uses the code observed
+            # BEFORE teardown: an exit-75 backfilled from our own
+            # SIGTERM (a straggler's PreemptionGuard answering the
+            # teardown) is collateral and must not re-label the
+            # incident as a platform preemption
+            observed_rc = rc
+            t_detect = time.monotonic()
+            _tele.emit({"ts": time.time(), "source": "resilience",
+                        "event": "rank_lost", "rank": rank,
+                        "exit_code": rc, "step_time": 0.0,
+                        "generation": self.generation})
+            rcs = self._teardown()
+            if rc is None:
+                # the wedged root-cause rank only has an exit code
+                # once our teardown signalled it
+                rc = rcs.get(rank)
+            incident = {"generation": self.generation, "rank": rank,
+                        "exit_code": rc, "rank_exit_codes": rcs,
+                        "wedged": wedged, "ts": time.time()}
+            # restart-vs-stop is decided by the ROOT CAUSE alone: in a
+            # real platform preemption every rank gets the SIGTERM and
+            # the first failure observed is an exit-75; when a rank
+            # CRASHES first (OOM SIGKILL — the flagship scenario), the
+            # stragglers' exit-75s are collateral of OUR teardown
+            # SIGTERM and must not re-label the crash as preemption
+            if observed_rc == EXIT_PREEMPTED:
+                # external eviction, not a crash: the host is going
+                # away — restarting here is futile; the checkpoints are
+                # committed and a fresh allocation resumes from them
+                incident["action"] = "stop (preempted)"
+                incident["downtime_s"] = 0.0
+                self.incidents.append(incident)
+                _logger().warning(
+                    "gang preempted (rank %d exit %d): stopping without "
+                    "restart", rank, rc)
+                return EXIT_PREEMPTED
+            if self.restarts >= self.max_restarts:
+                incident["action"] = ("give up (restart budget %d "
+                                      "exhausted)" % self.max_restarts)
+                incident["downtime_s"] = None
+                self.incidents.append(incident)
+                _logger().error(
+                    "gang failed (rank %d exit %s) with the restart "
+                    "budget exhausted (%d/%d) — giving up",
+                    rank, rc, self.restarts, self.max_restarts)
+                return rc if rc else 1
+            backoff = min(60.0,
+                          self.backoff_s * (2.0 ** self.restarts))
+            _logger().warning(
+                "gang failure: rank %d exited %s (generation %d) — "
+                "tearing down and relaunching in %.3gs (restart %d/%d)",
+                rank, rc, self.generation, backoff,
+                self.restarts + 1, self.max_restarts)
+            if backoff > 0:
+                time.sleep(backoff)
+            self.restarts += 1
+            self.generation += 1
+            RESTARTS.inc()
+            self.spawn()
+            downtime = time.monotonic() - t_detect
+            DOWNTIME.observe(downtime)
+            incident["action"] = "restart"
+            incident["downtime_s"] = round(downtime, 3)
+            incident["backoff_s"] = backoff
+            self.incidents.append(incident)
+            _tele.emit({"ts": time.time(), "source": "resilience",
+                        "event": "gang_restart", "rank": rank,
+                        "exit_code": rc, "restarts": self.restarts,
+                        "step_time": downtime,
+                        "generation": self.generation})
+
+    def _watch_generation(self):
+        """Poll the gang: returns None when every rank exited 0, or
+        (rank, returncode) for the failure that best names the ROOT
+        CAUSE in the poll sweep that first saw one — a rank killed by
+        a signal or plain-crashing beats a survivor reporting
+        EXIT_PEER_LOST (expected collateral). Rank heartbeat ages are
+        mirrored into the gauge while we wait."""
+        while True:
+            running, failures = False, []
+            for rank, p in enumerate(self.procs):
+                rc = p.poll()
+                if rc is None:
+                    running = True
+                elif rc != 0:
+                    failures.append((rank, rc))
+            if failures:
+                # crash/signal > preempted > peer-lost: the collateral
+                # codes must never outrank the failure that caused them
+                for rank, rc in failures:
+                    if rc not in (EXIT_PEER_LOST, EXIT_PREEMPTED):
+                        return rank, rc
+                for rank, rc in failures:
+                    if rc == EXIT_PREEMPTED:
+                        return rank, rc
+                return failures[0]
+            if not running:
+                return None
+            peer_status(self.dir)      # refresh heartbeat-age gauge
+            time.sleep(self.poll_s)
+
+    # -- reporting -----------------------------------------------------
+    def report(self):
+        return {"nranks": self.nranks, "generation": self.generation,
+                "restarts": self.restarts, "gang_dir": self.dir,
+                "incidents": list(self.incidents)}
+
+    def _write_report(self):
+        try:
+            with atomic_write(os.path.join(self.dir, "report.json"),
+                              "w") as f:
+                f.write(json.dumps(self.report(), sort_keys=True))
+        except OSError:
+            pass

@@ -28,6 +28,7 @@ Env knobs (docs/fault_tolerance.md):
 """
 from __future__ import annotations
 
+import threading
 import time
 
 from ..base import MXNetError, getenv, probe_devices
@@ -120,6 +121,61 @@ class HealthWatchdog:
             collective_timeout_s if collective_timeout_s is not None
             else getenv("MXTPU_WATCHDOG_COLLECTIVE_S", 0.0))
         self.lease_path = lease_path
+        # persistent guard worker (peer-checked collectives run every
+        # bucket through here — a fresh thread per call would tax the
+        # hot allreduce path); lazily started, single-slot
+        self._worker_lock = threading.Lock()
+        self._worker_q = None
+        self._worker_busy = False
+
+    # -- guard worker ---------------------------------------------------
+    def _worker_loop(self, q):
+        while True:
+            fn, box, done = q.get()
+            try:
+                box["result"] = fn()
+            except BaseException as err:  # delivered via the box
+                box["error"] = err
+            # the WORKER clears its own busy flag (a guard that gave
+            # up on this collective is long gone; the worker must
+            # become reusable the moment the stuck call returns), and
+            # clears it BEFORE done.set() so the waiter's very next
+            # guarded collective finds it free instead of racing into
+            # the ephemeral-thread fallback
+            with self._worker_lock:
+                self._worker_busy = False
+            done.set()
+
+    def _submit(self, fn, what):
+        """Run `fn` off-thread, returning its (box, done) pair. Reuses
+        ONE persistent daemon worker; when that worker is wedged
+        holding a previous collective that never returned (a tripped
+        deadline — the process is suspect but may still be unwinding),
+        falls back to an ephemeral thread so the guard itself never
+        blocks."""
+        box, done = {}, threading.Event()
+        with self._worker_lock:
+            if not self._worker_busy:
+                if self._worker_q is None:
+                    import queue
+                    self._worker_q = queue.Queue()
+                    threading.Thread(
+                        target=self._worker_loop,
+                        args=(self._worker_q,), daemon=True,
+                        name="watchdog-guard-worker").start()
+                self._worker_busy = True
+                self._worker_q.put((fn, box, done))
+                return box, done
+
+        def target():
+            try:
+                box["result"] = fn()
+            except BaseException as err:
+                box["error"] = err
+            done.set()
+        threading.Thread(target=target, daemon=True,
+                         name="deadline:%s" % what).start()
+        return box, done
 
     def init_devices(self, timeout_s=None, probe=None):
         """Deadline-bounded backend init: returns the device list or
@@ -141,31 +197,98 @@ class HealthWatchdog:
             "device backend unreachable: %s (init bounded at %.6gs)"
             % (err, t), diag)
 
-    def guard_collective(self, fn, what="collective", timeout_s=None):
+    def guard_collective(self, fn, what="collective", timeout_s=None,
+                         peer_check=None):
         """Run `fn()` under a deadline; a trip dumps diagnostics and
         re-raises the `DeadlineExceeded` (clean abort — the process
         state is suspect, never silently retried). `timeout_s` 0/None
-        falls back to the instance default; 0 there means unguarded."""
-        return self._guard(fn, what, timeout_s,
-                           self.collective_timeout_s, "collective")
+        falls back to the instance default; 0 there means unguarded.
 
-    def guard_init(self, fn, what="backend init", timeout_s=None):
+        `peer_check` is the gang-supervision fast path
+        (`resilience.supervisor.peer_checker`): a callable polled every
+        `MXTPU_GANG_PEER_POLL_S` while the collective waits, raising a
+        typed `PeerLost` naming the dead rank — survivors abort in
+        seconds instead of waiting out the whole collective budget,
+        and a deadline trip gets one final peer check so a dead peer
+        is reported as `PeerLost`, never a generic `DeadlineExceeded`.
+        With a peer_check, the collective is monitored even when no
+        deadline is configured (a supervised gang must never block
+        forever on a dead peer)."""
+        return self._guard(fn, what, timeout_s,
+                           self.collective_timeout_s, "collective",
+                           peer_check=peer_check)
+
+    def guard_init(self, fn, what="backend init", timeout_s=None,
+                   peer_check=None):
         """Like guard_collective but for init-shaped work (trips count
         under kind=init): bounds calls such as
         `jax.distributed.initialize` that can block forever on a dead
         coordinator."""
         return self._guard(fn, what, timeout_s, self.init_timeout_s,
-                           "init")
+                           "init", peer_check=peer_check)
 
-    def _guard(self, fn, what, timeout_s, default_t, kind):
+    def _guard(self, fn, what, timeout_s, default_t, kind,
+               peer_check=None):
         t = float(timeout_s if timeout_s is not None else default_t)
-        if t <= 0:
+        if t <= 0 and peer_check is None:
             return fn()
         try:
-            return run_with_deadline(fn, t, what=what)
+            if peer_check is None:
+                return run_with_deadline(fn, t, what=what)
+            return self._guard_with_peers(fn, t, what, peer_check)
         except DeadlineExceeded as err:
             diag = self._trip(kind, what, t)
             raise DeadlineExceeded("%s\n%s" % (err, diag)) from err
+
+    def _guard_with_peers(self, fn, t, what, peer_check):
+        """run_with_deadline with a peer poll: `fn` runs on the
+        persistent guard worker (a blocked collective cannot be
+        cancelled from Python) while this thread waits in short
+        slices, calling `peer_check` each slice. A raised `PeerLost`
+        (or any peer_check error) propagates immediately — the
+        collective stays blocked on its worker, the process state is
+        suspect, and the caller aborts with a *named* culprit (later
+        guards fall back to ephemeral threads while the worker is
+        wedged). `t` <= 0 means no deadline: only the peer poll
+        bounds the wait."""
+        poll = max(0.05, float(getenv("MXTPU_GANG_PEER_POLL_S", 0.5)))
+        box, finished = self._submit(fn, what)
+        end = (time.monotonic() + t) if t > 0 else None
+        while True:
+            # never sleep past the deadline: a sub-poll budget must
+            # trip on time, not be rounded up to the poll interval
+            slice_s = poll if end is None else \
+                min(poll, max(0.0, end - time.monotonic()))
+            if finished.wait(timeout=slice_s):
+                break
+            try:
+                peer_check()
+            except MXNetError:
+                TRIPS.inc(kind="peer")
+                raise
+            if end is not None and time.monotonic() >= end:
+                try:
+                    peer_check()   # last look: name the culprit if any
+                except MXNetError:
+                    TRIPS.inc(kind="peer")
+                    raise
+                raise DeadlineExceeded(
+                    "%s did not complete within %.6gs and every gang "
+                    "peer still heartbeats — a peer process likely "
+                    "wedged without dying (the call is still blocked "
+                    "on a daemon thread; see docs/fault_tolerance.md)"
+                    % (what, t))
+        if "error" in box:
+            # a collective that ERRORS while a peer is dead (gloo
+            # connection reset, coordinator gone) is diagnosed as the
+            # dead peer — PeerLost, with the transport error chained
+            try:
+                peer_check()
+            except MXNetError as lost:
+                TRIPS.inc(kind="peer")
+                raise lost from box["error"]
+            raise box["error"]
+        return box.get("result")
 
     def _trip(self, kind, what, budget):
         TRIPS.inc(kind=kind)

@@ -1,0 +1,114 @@
+"""Supervised gang-training worker for the gang-restart tests.
+
+One rank of an N-process data-parallel run under a GangSupervisor
+(`tools/launch.py --supervise`): deterministic per-(step, rank)
+gradients are summed through the DistKVStore bucketed exchange, the
+parameter vector is updated identically on every rank, and rank 0
+checkpoints every step through TrainerCheckpoint's two-phase commit
+(commit barrier = `kv.barrier`). On (re)start every rank restores the
+latest *committed* step, so the whole parameter trajectory after a
+mid-run rank kill must bit-match an uninterrupted run — the ISSUE-8
+acceptance oracle.
+
+Each rank appends JSONL events to `<out>.r<rank>.jsonl`:
+  {"event": "start", "restored_step": ..., "generation": ...}
+  {"event": "done", "step": ..., "params_hex": <float32 bytes>}
+
+The `worker.kill` chaos site fires at every `at_step_boundary()`; the
+gang-restart test arms it on one rank via tools/chaos_run.py
+--kill-rank. Exit codes follow the gang contract via run_supervised
+(preempted 75 / peer lost 76 / crash).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.parallel.kvstore_dist import init_distributed
+    init_distributed()
+    rank = jax.process_index()
+    nproc = jax.process_count()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    from mxnet_tpu.resilience import at_step_boundary, run_supervised
+
+    out_path = "%s.r%d.jsonl" % (args.out, rank)
+
+    def emit(rec):
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+
+    class _State:
+        """The TrainerCheckpoint state contract (params/aux/opt_state/
+        step) without a full ShardedTrainer — the gang keeps params
+        replicated via the kvstore exchange, as HOST arrays (a
+        process-local jax array is not serializable in a multiprocess
+        world; the replicated numpy copy is, and stays bit-exact)."""
+
+        def __init__(self):
+            self._params = {"w": np.zeros((args.dim,), "float32")}
+            self._aux = {}
+            self._opt_state = {}
+            self._step_count = 0
+
+    kv = mx.kv.create("dist_sync")
+    kv.init("g", mx.nd.zeros((args.dim,)))
+    st = _State()
+    # rank 0 owns the (replicated) state on disk; the commit barrier
+    # is the gang-wide fence — every rank reaches the same post-save
+    # point before the step is sealed
+    ck = TrainerCheckpoint(args.ckpt_dir, max_to_keep=3,
+                           single_host=True, primary=(rank == 0),
+                           commit_barrier=(kv.barrier if rank == 0
+                                           else None))
+    restored = ck.restore_latest(st)
+    kv.barrier()    # everyone resumes from the same committed step
+    emit({"event": "start", "rank": rank, "restored_step": restored,
+          "generation": int(os.environ.get("MXTPU_GANG_GENERATION",
+                                           -1))})
+
+    def body():
+        for step in range(st._step_count + 1, args.steps + 1):
+            at_step_boundary()   # worker.kill chaos site + preemption
+            rng = np.random.RandomState(100003 * step + 17 * rank)
+            noise = rng.randn(args.dim).astype("float32")
+            grad = np.float32(0.1) * st._params["w"] + noise
+            kv.push("g", mx.nd.array(grad))
+            gout = mx.nd.zeros((args.dim,))
+            kv.pull("g", out=gout)
+            gsum = gout.asnumpy().astype("float32")
+            st._params["w"] = (st._params["w"]
+                               - np.float32(0.05) * gsum
+                               / np.float32(nproc)).astype("float32")
+            st._step_count = step
+            if rank == 0:
+                ck.save(step, st, wait=True)   # commit barrier inside
+            else:
+                kv.barrier()                   # the same fence
+        emit({"event": "done", "rank": rank, "step": st._step_count,
+              "params_hex":
+              np.asarray(st._params["w"], "float32").tobytes().hex()})
+        print("GANG_WORKER_%d_DONE" % rank, flush=True)
+
+    run_supervised(body)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""End-to-end elastic gang supervision (ISSUE 8 acceptance): a chaos
+rank kill mid-run must yield supervisor-driven restart, resume at the
+last committed checkpoint step, and a parameter trajectory bit-identical
+to an uninterrupted run — plus seconds-level PeerLost detection for
+survivors of a SIGKILLed peer.
+
+Real processes end to end: tools/chaos_run.py --kill-rank arms the
+worker.kill chaos site on one rank, tools/launch.py --supervise runs
+the 4-rank gang under a GangSupervisor, and tests/gang_worker.py is
+the training loop (DistKVStore exchange + TrainerCheckpoint two-phase
+commit)."""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NPROC = 4
+STEPS = 6
+KILL_AFTER = 3          # rank dies entering step KILL_AFTER + 1
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # workers use their own 1-device CPU
+    env.pop("MXTPU_CHAOS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXTPU_GANG_PEER_POLL_S"] = "0.2"
+    env.update(extra or {})
+    return env
+
+
+def _worker_cmd(ckpt_dir, out):
+    return [sys.executable, os.path.join(ROOT, "tests",
+                                         "gang_worker.py"),
+            "--steps", str(STEPS), "--ckpt-dir", str(ckpt_dir),
+            "--out", str(out)]
+
+
+def _supervised_cmd(gang_dir, ckpt_dir, out):
+    return [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+            "-n", str(NPROC), "--supervise",
+            "--gang-dir", str(gang_dir),
+            "--max-restarts", "2", "--restart-backoff", "0.2"
+            ] + _worker_cmd(ckpt_dir, out)
+
+
+def _read_events(out, rank):
+    path = "%s.r%d.jsonl" % (out, rank)
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.mark.slow
+def test_gang_restart_resumes_committed_step_bit_identical(tmp_path):
+    """The ISSUE-8 end-to-end chaos proof: kill rank 2 after step 3 of
+    a 4-proc supervised run — the gang restarts exactly once, resumes
+    from the last committed step (3), and the final parameters
+    bit-match an uninterrupted reference run's."""
+    # --- uninterrupted reference run -------------------------------
+    ref = subprocess.run(
+        _supervised_cmd(tmp_path / "gang_ref", tmp_path / "ck_ref",
+                        tmp_path / "ref"),
+        env=_env(), capture_output=True, text=True, timeout=240)
+    assert ref.returncode == 0, ref.stdout[-4000:] + ref.stderr[-2000:]
+    ref_done = {r: [e for e in _read_events(tmp_path / "ref", r)
+                    if e["event"] == "done"] for r in range(NPROC)}
+    assert all(len(d) == 1 for d in ref_done.values())
+    ref_hex = {r: d[0]["params_hex"] for r, d in ref_done.items()}
+    # replicated state: every rank ended with the same bits
+    assert len(set(ref_hex.values())) == 1
+
+    # --- chaos run: SIGKILL rank 2 mid-run via chaos_run -----------
+    chaos = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_run.py"),
+         "--kill-rank", "2", "--after-steps", str(KILL_AFTER),
+         "--timeout", "200", "--expect", "complete", "--"
+         ] + _supervised_cmd(tmp_path / "gang", tmp_path / "ck",
+                             tmp_path / "out"),
+        env=_env(), capture_output=True, text=True, timeout=240)
+    assert chaos.returncode == 0, \
+        chaos.stdout[-4000:] + chaos.stderr[-2000:]
+    verdict = json.loads(chaos.stdout.strip().splitlines()[-1])
+    assert verdict["outcome"] == "COMPLETED"
+    assert "worker.kill" in verdict["chaos_sites"]
+
+    # supervisor report: exactly one restart, the kill as the incident
+    report = json.loads(open(
+        os.path.join(str(tmp_path / "gang"), "report.json")).read())
+    assert report["restarts"] == 1, report
+    assert len(report["incidents"]) == 1
+    inc = report["incidents"][0]
+    assert inc["action"] == "restart"
+    assert inc["rank_exit_codes"]["2"] == -signal.SIGKILL
+    assert inc["downtime_s"] >= 0.0
+
+    # every rank of generation 1 resumed from the last COMMITTED step
+    for r in range(NPROC):
+        events = _read_events(tmp_path / "out", r)
+        starts = [e for e in events if e["event"] == "start"]
+        assert [e["generation"] for e in starts] == [0, 1]
+        assert starts[0]["restored_step"] is None
+        assert starts[1]["restored_step"] == KILL_AFTER
+        done = [e for e in events if e["event"] == "done"]
+        assert len(done) == 1 and done[0]["step"] == STEPS
+        # the acceptance oracle: post-resume params bit-match the
+        # uninterrupted run
+        assert done[0]["params_hex"] == ref_hex[0], \
+            "rank %d diverged after resume" % r
+
+    # only committed steps remain restorable in the checkpoint dir
+    ckpt_steps = sorted(int(d) for d in os.listdir(str(tmp_path / "ck"))
+                        if d.isdigit())
+    assert KILL_AFTER in ckpt_steps or STEPS in ckpt_steps
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_survivor_raises_peer_lost_faster_than_watchdog(tmp_path):
+    """A SIGKILLed peer is detected by the survivor via the rank
+    heartbeat in seconds — well inside the collective-watchdog budget
+    (120s barrier here) — and the raised error is PeerLost naming the
+    dead rank (exit code 76), not a DeadlineExceeded after the wait."""
+    gang_dir = str(tmp_path / "gang")
+    os.makedirs(gang_dir)
+    coordinator = "127.0.0.1:%d" % _free_port()
+    base = {
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": "2",
+        "MXTPU_GANG_DIR": gang_dir,
+        "MXTPU_BARRIER_TIMEOUT_S": "120",
+        "MXTPU_WATCHDOG_COLLECTIVE_S": "120",
+        # rank 1 SIGKILLs itself entering step 2; rank 0 then waits in
+        # the step-2 collective on a dead peer
+        "MXTPU_CHAOS_RANK_1": "worker.kill:kind=kill,after=1",
+    }
+    procs = []
+    for r in range(2):
+        env = _env(dict(base, JAX_PROCESS_ID=str(r)))
+        procs.append(subprocess.Popen(
+            _worker_cmd(tmp_path / "ck", tmp_path / "out"),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env))
+    outs = [None, None]
+    try:
+        # rank 1 SIGKILLs itself first; the detection window starts at
+        # its death, so slow jax startup on a loaded 1-core VM cannot
+        # pollute the measurement
+        out1, _ = procs[1].communicate(timeout=180)
+        t_kill = time.monotonic()
+        outs[1] = out1.decode(errors="replace")
+        out0, _ = procs[0].communicate(timeout=180)
+        detection = time.monotonic() - t_kill
+        outs[0] = out0.decode(errors="replace")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert procs[1].returncode == -signal.SIGKILL, outs[1][-2000:]
+    # the survivor: typed PeerLost naming rank 1, exit code 76, and
+    # decided in seconds — not the 120s collective budget
+    assert procs[0].returncode == 76, outs[0][-3000:]
+    assert "rank 1 is lost" in outs[0], outs[0][-3000:]
+    assert detection < 60.0, detection

@@ -1,0 +1,715 @@
+"""Elastic gang supervision (resilience/supervisor.py, ISSUE 8): rank
+heartbeats, fast dead-peer detection (`PeerLost`), the GangSupervisor
+restart state machine, the exit-code contract, the `worker.kill` chaos
+site, two-phase checkpoint commit, and the kill_stale SUPERVISED tag.
+
+The slow 4-process end-to-end proof lives in test_gang_restart.py;
+these tests are the fast single-host slice of the same machinery."""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from mxnet_tpu.resilience import (EXIT_PEER_LOST, EXIT_PREEMPTED,
+                                  GangSupervisor, PeerLost,
+                                  RankHeartbeat, TrainingPreempted)
+from mxnet_tpu.resilience.supervisor import (dead_peers, exit_status,
+                                             peer_checker, peer_status,
+                                             read_heartbeat)
+from mxnet_tpu.resilience.watchdog import HealthWatchdog
+from mxnet_tpu.resilience.retry import DeadlineExceeded
+
+
+# -- exit-code contract ---------------------------------------------------
+
+def test_exit_code_contract():
+    """Preempted vs peer-lost vs crash are distinct exit codes, so the
+    supervisor decides restart-vs-stop without parsing stderr."""
+    assert TrainingPreempted.exit_code == EXIT_PREEMPTED == 75
+    assert PeerLost.exit_code == EXIT_PEER_LOST == 76
+    assert EXIT_PREEMPTED != EXIT_PEER_LOST
+    err = PeerLost("rank down", rank=3)
+    assert err.rank == 3
+    assert exit_status(err) == EXIT_PEER_LOST
+    assert exit_status(TrainingPreempted("bye", step=7)) == EXIT_PREEMPTED
+    assert exit_status(RuntimeError("boom")) == 1
+
+
+# -- rank heartbeats ------------------------------------------------------
+
+def test_heartbeat_roundtrip_and_peer_status(tmp_path):
+    d = str(tmp_path)
+    hb = RankHeartbeat(0, d, interval_s=0.05)
+    hb.beat(step=4)
+    rec = read_heartbeat(os.path.join(d, "rank_0.hb"))
+    assert rec["rank"] == 0 and rec["pid"] == os.getpid()
+    assert rec["step"] == 4
+    assert isinstance(rec["starttime"], int)
+    # peer view: we are alive, and exclude_rank hides ourselves
+    st = peer_status(d)
+    assert [s["rank"] for s in st] == [0]
+    assert st[0]["alive"] and st[0]["age_s"] < 5.0
+    assert peer_status(d, exclude_rank=0) == []
+    assert dead_peers(d) == []
+    hb.stop(unlink=True)
+    assert not os.path.exists(os.path.join(d, "rank_0.hb"))
+
+
+def _spawn_rank_beacon(d, rank):
+    """A real peer process that writes its heartbeat then sleeps."""
+    code = ("import sys; sys.path.insert(0, %r);"
+            "from mxnet_tpu.resilience.supervisor import RankHeartbeat;"
+            "RankHeartbeat(%d, %r).beat();"
+            "print('BEATING', flush=True);"
+            "import time; time.sleep(600)" % (ROOT, rank, d))
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE)
+    assert b"BEATING" in p.stdout.readline()
+    return p
+
+
+def test_sigkilled_peer_is_provably_dead_immediately(tmp_path):
+    """A SIGKILLed peer's heartbeat file proves it dead via the pid/
+    starttime identity record — no timeout has to elapse."""
+    d = str(tmp_path)
+    p = _spawn_rank_beacon(d, 1)
+    try:
+        assert dead_peers(d, exclude_rank=0) == []
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+        t0 = time.monotonic()
+        dead = dead_peers(d, exclude_rank=0, timeout_s=1e9)
+        assert time.monotonic() - t0 < 2.0
+        assert [r for r, _ in dead] == [1]
+        assert "gone" in dead[0][1]
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+
+def test_wedged_peer_detected_by_heartbeat_timeout(tmp_path):
+    """A live-pid peer whose heartbeat went silent past the timeout is
+    wedged-dead (the watchdog cannot tell it from a hang)."""
+    d = str(tmp_path)
+    hb = RankHeartbeat(2, d)
+    hb.beat()
+    rec = read_heartbeat(hb.path)
+    rec["heartbeat"] = time.time() - 100.0
+    with open(hb.path, "w") as f:
+        f.write(json.dumps(rec))
+    assert dead_peers(d, exclude_rank=0, timeout_s=5.0) == [
+        (2, dead_peers(d, exclude_rank=0, timeout_s=5.0)[0][1])]
+    assert "silent" in dead_peers(d, exclude_rank=0, timeout_s=5.0)[0][1]
+    # fresh heartbeat: not dead
+    rec["heartbeat"] = time.time()
+    with open(hb.path, "w") as f:
+        f.write(json.dumps(rec))
+    assert dead_peers(d, exclude_rank=0, timeout_s=5.0) == []
+
+
+# -- PeerLost via the collective watchdog ---------------------------------
+
+def test_guard_collective_raises_peer_lost_before_watchdog_budget(
+        tmp_path, monkeypatch):
+    """The ISSUE-8 detection acceptance: a SIGKILLed peer is reported
+    while the collective watchdog budget (30s here) has barely
+    started — typed PeerLost naming the dead rank, not a generic
+    DeadlineExceeded after the full wait."""
+    monkeypatch.setenv("MXTPU_GANG_PEER_POLL_S", "0.1")
+    d = str(tmp_path)
+    p = _spawn_rank_beacon(d, 1)
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+    wd = HealthWatchdog()
+    check = peer_checker(exclude_rank=0, directory=d)
+    t0 = time.monotonic()
+    with pytest.raises(PeerLost) as ei:
+        wd.guard_collective(lambda: time.sleep(60),
+                            what="stand-in collective",
+                            timeout_s=30.0, peer_check=check)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, elapsed          # seconds, not the 30s budget
+    assert ei.value.rank == 1
+    assert "rank 1" in str(ei.value)
+
+
+def test_guard_collective_peer_check_without_deadline(tmp_path,
+                                                      monkeypatch):
+    """With no collective deadline configured (the default), a
+    supervised gang still never blocks forever: the peer poll alone
+    bounds the wait."""
+    monkeypatch.setenv("MXTPU_GANG_PEER_POLL_S", "0.1")
+    d = str(tmp_path)
+    p = _spawn_rank_beacon(d, 3)
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+    wd = HealthWatchdog(collective_timeout_s=0.0)
+    with pytest.raises(PeerLost) as ei:
+        wd.guard_collective(lambda: time.sleep(60), timeout_s=0.0,
+                            peer_check=peer_checker(exclude_rank=0,
+                                                    directory=d))
+    assert ei.value.rank == 3
+
+
+def test_guard_collective_converts_collective_error_to_peer_lost(
+        tmp_path):
+    """When the collective itself errors (gloo connection reset) while
+    a peer is dead, the dead peer is the diagnosis — PeerLost, with
+    the transport error chained underneath."""
+    d = str(tmp_path)
+    p = _spawn_rank_beacon(d, 1)
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+
+    def exploding_collective():
+        raise RuntimeError("connection reset by peer")
+
+    wd = HealthWatchdog()
+    with pytest.raises(PeerLost) as ei:
+        wd.guard_collective(exploding_collective, timeout_s=30.0,
+                            peer_check=peer_checker(exclude_rank=0,
+                                                    directory=d))
+    assert ei.value.rank == 1
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+def test_guard_collective_deadline_with_live_peers(tmp_path,
+                                                   monkeypatch):
+    """All peers heartbeating but the collective still stuck: the
+    deadline trips as before (DeadlineExceeded, kind=collective) —
+    PeerLost is only for provably-lost peers."""
+    monkeypatch.setenv("MXTPU_GANG_PEER_POLL_S", "0.05")
+    d = str(tmp_path)
+    hb = RankHeartbeat(1, d, interval_s=0.05)
+    hb.start()
+    try:
+        wd = HealthWatchdog()
+        with pytest.raises(DeadlineExceeded):
+            wd.guard_collective(lambda: time.sleep(60),
+                                timeout_s=0.5,
+                                peer_check=peer_checker(
+                                    exclude_rank=0, directory=d))
+    finally:
+        hb.stop(unlink=True)
+
+
+# -- worker.kill chaos site ----------------------------------------------
+
+def _run_child(code, env=None):
+    full_env = dict(os.environ)
+    full_env.pop("MXTPU_CHAOS", None)
+    full_env.update(env or {})
+    return subprocess.run([sys.executable, "-c", code], env=full_env,
+                          capture_output=True, timeout=60)
+
+
+_KILL_CHILD = (
+    "import sys; sys.path.insert(0, %r);"
+    "from mxnet_tpu.resilience.preempt import at_step_boundary;"
+    "[at_step_boundary() for _ in range(6)];"
+    "print('SURVIVED', flush=True)" % ROOT)
+
+
+def test_chaos_kill_kind_sigkills_the_rank():
+    r = _run_child(_KILL_CHILD,
+                   env={"MXTPU_CHAOS": "worker.kill:kind=kill,after=2"})
+    assert r.returncode == -signal.SIGKILL
+    assert b"SURVIVED" not in r.stdout
+
+
+def test_chaos_rank_spec_arms_only_the_named_rank():
+    """MXTPU_CHAOS_RANK_<r> (the chaos_run --kill-rank plumbing) arms
+    only the rank whose rendezvous env matches."""
+    spec = {"MXTPU_CHAOS_RANK_2": "worker.kill:kind=kill"}
+    hit = _run_child(_KILL_CHILD,
+                     env=dict(spec, JAX_PROCESS_ID="2"))
+    assert hit.returncode == -signal.SIGKILL
+    miss = _run_child(_KILL_CHILD,
+                      env=dict(spec, JAX_PROCESS_ID="0"))
+    assert miss.returncode == 0, miss.stdout + miss.stderr
+    assert b"SURVIVED" in miss.stdout
+
+
+def test_chaos_rank_spec_merges_with_global_spec():
+    """A global MXTPU_CHAOS must not mask the per-rank spec (the
+    chaos_run --chaos + --kill-rank combination): the targeted rank
+    arms BOTH."""
+    env = {"MXTPU_CHAOS": "io.read:p=0",
+           "MXTPU_CHAOS_RANK_2": "worker.kill:kind=kill",
+           "JAX_PROCESS_ID": "2"}
+    hit = _run_child(_KILL_CHILD, env=env)
+    assert hit.returncode == -signal.SIGKILL, hit.stdout + hit.stderr
+
+
+# -- GangSupervisor state machine ----------------------------------------
+
+def _gen_rank_cmd(body):
+    """A tiny gang member: `g` and `r` are bound from the rendezvous
+    env the supervisor injects."""
+    return [sys.executable, "-c",
+            "import os, sys, time;"
+            "g=int(os.environ['MXTPU_GANG_GENERATION']);"
+            "r=int(os.environ['JAX_PROCESS_ID']);" + body]
+
+
+def test_supervisor_restarts_crashed_gang_once(tmp_path):
+    cmd = _gen_rank_cmd("sys.exit(3 if (g==0 and r==1) else 0)")
+    sup = GangSupervisor(cmd, 3, gang_dir=str(tmp_path),
+                         max_restarts=2, backoff_s=0.05)
+    rc = sup.run()
+    assert rc == 0
+    rep = sup.report()
+    assert sup.restarts == 1 and rep["restarts"] == 1
+    assert len(rep["incidents"]) == 1
+    inc = rep["incidents"][0]
+    assert inc["rank"] == 1 and inc["exit_code"] == 3
+    assert inc["action"] == "restart"
+    assert inc["downtime_s"] >= 0.05       # includes the backoff
+    # the report also lands on disk for harnesses
+    on_disk = json.loads(
+        open(os.path.join(str(tmp_path), "report.json")).read())
+    assert on_disk["restarts"] == 1
+
+
+def test_supervisor_stops_on_preemption_without_restart(tmp_path):
+    cmd = _gen_rank_cmd("sys.exit(%d if r==0 else 0)" % EXIT_PREEMPTED)
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=3, backoff_s=0.05)
+    assert sup.run() == EXIT_PREEMPTED
+    assert sup.restarts == 0
+    assert sup.report()["incidents"][0]["action"] == "stop (preempted)"
+
+
+def test_supervisor_restarts_when_crash_precedes_preempted_collateral(
+        tmp_path):
+    """The flagship OOM/SIGKILL scenario with PreemptionGuard-equipped
+    stragglers: the crash is the root cause; survivors exiting 75 in
+    response to OUR teardown SIGTERM are collateral and must not
+    re-label the incident as a preemption (which would stop instead of
+    restart)."""
+    cmd = _gen_rank_cmd(
+        "import signal as sg;"
+        "sg.signal(sg.SIGTERM, lambda *a: sys.exit(%d));"
+        "sys.exit(0) if g else ("
+        "sys.exit(9) if r==0 else time.sleep(600))" % EXIT_PREEMPTED)
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=2, backoff_s=0.05,
+                         kill_grace_s=2.0)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    inc = sup.report()["incidents"][0]
+    assert inc["action"] == "restart"
+    assert inc["rank"] == 0 and inc["exit_code"] == 9
+    # the straggler really did exit with the preemption code
+    assert inc["rank_exit_codes"][1] == EXIT_PREEMPTED
+
+
+def test_supervisor_attributes_wedged_peer_not_first_reporter(tmp_path):
+    """When every observed exit is a survivor's EXIT_PEER_LOST (the
+    wedged-but-alive peer never exits on its own), the incident must
+    name the wedged rank from the heartbeats — not the first reporter,
+    and not another 76-exited survivor whose lingering heartbeat file
+    also reads as dead (collateral is never the root cause)."""
+    # rank 1 "wedges": writes a heartbeat far in the past, then
+    # sleeps; ranks 0 and 2 play survivors — each leaves a heartbeat
+    # with its own pid (dead once exited) and exits 76 at staggered
+    # times, so the reattribution must skip a dead 76-survivor and
+    # land on the wedged rank whatever the observation order
+    cmd = _gen_rank_cmd(
+        "import json;"
+        "sys.exit(0) if g else None;"
+        "d=os.environ['MXTPU_GANG_DIR'];"
+        "json.dump({'rank':r,'pid':os.getpid(),"
+        "'heartbeat':1.0 if r==1 else 1e12},"
+        "open(os.path.join(d,'rank_%%d.hb'%%r),'w'));"
+        "time.sleep(600) if r==1 else "
+        "(time.sleep(0.5 if r==2 else 2.0), sys.exit(%d))"
+        % EXIT_PEER_LOST)
+    sup = GangSupervisor(cmd, 3, gang_dir=str(tmp_path),
+                         max_restarts=1, backoff_s=0.05,
+                         kill_grace_s=1.0)
+    assert sup.run() == 0
+    inc = sup.report()["incidents"][0]
+    assert inc["rank"] == 1, inc          # the wedged one
+    assert inc["wedged"] is True
+    assert inc["exit_code"] < 0           # reaped by our teardown
+
+
+def test_supervisor_gives_up_after_restart_budget(tmp_path):
+    cmd = _gen_rank_cmd("sys.exit(9)")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=1, backoff_s=0.05)
+    assert sup.run() == 9
+    assert sup.restarts == 1
+    actions = [i["action"] for i in sup.report()["incidents"]]
+    assert actions[0] == "restart" and "give up" in actions[-1]
+
+
+def test_supervisor_tears_down_stragglers(tmp_path):
+    """Rank 1 dies; rank 0 would sleep 600s (the survivor hanging on
+    its next collective) — the supervisor must reap it promptly."""
+    cmd = _gen_rank_cmd(
+        "sys.exit(5) if r==1 else time.sleep(600)")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=0, backoff_s=0.05,
+                         kill_grace_s=1.0)
+    t0 = time.monotonic()
+    rc = sup.run()
+    assert time.monotonic() - t0 < 30.0
+    assert rc == 5
+    codes = sup.report()["incidents"][0]["rank_exit_codes"]
+    assert codes[1] == 5
+    assert codes[0] < 0          # straggler signalled, not left behind
+
+
+def test_supervisor_strips_rank_chaos_env_on_relaunch(tmp_path):
+    """An injected incident happens ONCE: MXTPU_CHAOS_RANK_* reaches
+    generation 0 only, so the recovered gang cannot re-kill itself
+    forever."""
+    cmd = _gen_rank_cmd(
+        "sys.exit(4 if os.environ.get('MXTPU_CHAOS_RANK_0') else 0)")
+    env = dict(os.environ, MXTPU_CHAOS_RANK_0="worker.kill:kind=kill")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path), base_env=env,
+                         max_restarts=2, backoff_s=0.05)
+    assert sup.run() == 0
+    assert sup.restarts == 1     # gen 0 died via the env, gen 1 clean
+
+
+def test_supervisor_clears_stale_heartbeats_between_generations(
+        tmp_path):
+    """A dead previous generation's heartbeat files must not poison
+    the relaunched gang with instant PeerLost."""
+    stale = os.path.join(str(tmp_path), "rank_7.hb")
+    with open(stale, "w") as f:
+        f.write(json.dumps({"rank": 7, "pid": 2 ** 22 + 1,
+                            "heartbeat": time.time() - 1e6}))
+    cmd = _gen_rank_cmd("sys.exit(0)")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=0, backoff_s=0.05)
+    assert sup.run() == 0
+    assert not os.path.exists(stale)
+
+
+def test_supervisor_adopts_externally_spawned_gang(tmp_path):
+    """`adopt()` attaches supervision to ranks the caller already
+    launched: liveness watching, teardown, and restart (spawned by the
+    supervisor from then on) all apply."""
+    cmd = _gen_rank_cmd("sys.exit(0 if g else 2)")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=1, backoff_s=0.05)
+    # external launcher: generation "0" spawned by the caller (crash)
+    external = [subprocess.Popen(
+        [sys.executable, "-c", "import sys; sys.exit(2)"])
+        for _ in range(2)]
+    rc = sup.run(procs=external)
+    assert rc == 0                 # relaunched generation exits clean
+    assert sup.restarts == 1
+
+
+def test_supervisor_record_written_for_kill_stale(tmp_path):
+    cmd = _gen_rank_cmd("sys.exit(0)")
+    sup = GangSupervisor(cmd, 2, gang_dir=str(tmp_path),
+                         max_restarts=0, backoff_s=0.05)
+    sup.run()
+    rec = json.loads(open(
+        os.path.join(str(tmp_path), "supervisor.json")).read())
+    assert rec["what"] == "gang-supervisor"
+    assert rec["pid"] == os.getpid()
+    assert rec["nranks"] == 2
+    assert isinstance(rec["starttime"], int)
+
+
+# -- two-phase checkpoint commit -----------------------------------------
+
+def _mini_rig():
+    """(make_trainer, x, y): trainers share ONE net so checkpoints
+    restore across instances (param names are per-net)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    m = nn.HybridSequential()
+    m.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    m.initialize()
+    m(mx.nd.zeros((1, 6)))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make_trainer():
+        return ShardedTrainer(m, lambda o, l: loss(o, l), "sgd",
+                              {"learning_rate": 0.05},
+                              mesh=make_mesh({"dp": 8}))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype("float32")
+    y = (np.arange(8) % 4).astype("float32")
+    return make_trainer, x, y
+
+
+def test_commit_manifest_written_and_step_committed(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import (TrainerCheckpoint,
+                                               COMMIT_BASENAME)
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        tr.step(x, y)
+        ck.save(1, tr, wait=True)
+        marker = os.path.join(str(tmp_path / "ck"), "1",
+                              COMMIT_BASENAME)
+        assert os.path.exists(marker)
+        manifest = json.loads(open(marker).read())
+        assert manifest["step"] == 1
+        assert manifest["files"]           # per-file sha256/size map
+        for ent in manifest["files"].values():
+            assert len(ent["sha256"]) == 64 and ent["bytes"] >= 0
+        assert ck.committed_steps() == [1]
+
+
+def test_restore_latest_refuses_uncommitted_step(tmp_path):
+    """A gang killed mid-save leaves the newest step without its
+    commit marker: restore_latest must fall back to the previous
+    committed step, never resume from the torn one."""
+    from mxnet_tpu.observability import registry as obs
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    rejected = obs.REGISTRY.get("checkpoint.rejected")
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        for s in (1, 2):
+            tr.step(x, y)
+            ck.save(s, tr, wait=True)
+        os.unlink(ck._commit_path(2))       # the torn-save signature
+        before = rejected.total()
+        tr2 = mk()
+        with pytest.warns(RuntimeWarning, match="step 2 .* unreadable"):
+            assert ck.restore_latest(tr2) == 1
+        assert rejected.total() > before
+
+
+def test_restore_latest_refuses_checksum_mismatch(tmp_path):
+    """A step whose data was silently truncated/corrupted AFTER commit
+    fails manifest verification and is rejected the same way."""
+    from mxnet_tpu.parallel.checkpoint import (TrainerCheckpoint,
+                                               COMMIT_BASENAME)
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        for s in (1, 2):
+            tr.step(x, y)
+            ck.save(s, tr, wait=True)
+        step_dir = os.path.join(str(tmp_path / "ck"), "2")
+        clobbered = 0
+        for root, _dirs, files in os.walk(step_dir):
+            for fn in files:
+                if fn in (COMMIT_BASENAME, "_CHECKPOINT_METADATA"):
+                    continue
+                with open(os.path.join(root, fn), "wb") as f:
+                    f.write(b"\x00torn\x00")
+                clobbered += 1
+        assert clobbered
+        tr2 = mk()
+        with pytest.warns(RuntimeWarning, match="checksum"):
+            assert ck.restore_latest(tr2) == 1
+
+
+def test_rejected_step_is_dropped_so_resume_can_resave_it(tmp_path):
+    """The recovery loop re-trains and RE-SAVES the very step whose
+    torn save was rejected; the corpse must be gone or orbax raises
+    StepAlreadyExistsError and recovery becomes a crash loop."""
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        for s in (1, 2):
+            tr.step(x, y)
+            ck.save(s, tr, wait=True)
+        os.unlink(ck._commit_path(2))       # torn save of step 2
+    # a fresh manager (the relaunched gang) restores, then re-saves 2
+    with TrainerCheckpoint(tmp_path / "ck") as ck2:
+        tr2 = mk()
+        with pytest.warns(RuntimeWarning, match="step 2"):
+            assert ck2.restore_latest(tr2) == 1
+        assert not os.path.isdir(
+            os.path.join(str(tmp_path / "ck"), "2"))
+        tr2.step(x, y)
+        ck2.save(2, tr2, wait=True)         # must not raise
+        assert ck2.committed_steps() == [1, 2]
+
+
+def test_mixed_history_keeps_legacy_steps_restorable(tmp_path):
+    """An upgraded run has pre-commit-era steps (no manifest) below a
+    committed one: when the newest committed step is rejected
+    (corrupted), the fallback must reach the older legacy step — only
+    steps NEWER than the newest committed one count as torn."""
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        for s in (1, 2):
+            tr.step(x, y)
+            ck.save(s, tr, wait=True)
+        os.unlink(ck._commit_path(1))     # step 1: legacy (pre-upgrade)
+        # corrupt the committed newest step so it fails its checksums
+        step_dir = os.path.join(str(tmp_path / "ck"), "2")
+        for root, _dirs, files in os.walk(step_dir):
+            for fn in files:
+                if fn not in ("mxtpu_commit.json",
+                              "_CHECKPOINT_METADATA"):
+                    with open(os.path.join(root, fn), "wb") as f:
+                        f.write(b"torn")
+        tr2 = mk()
+        with pytest.warns(RuntimeWarning, match="step 2"):
+            assert ck.restore_latest(tr2) == 1   # legacy step survives
+
+
+def test_legacy_directory_without_markers_still_restores(tmp_path):
+    """Checkpoints written before two-phase commit have no manifests
+    anywhere — they must keep restoring (enforcement arms only once a
+    committed step exists)."""
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck") as ck:
+        for s in (1, 2):
+            tr.step(x, y)
+            ck.save(s, tr, wait=True)
+        for s in (1, 2):
+            os.unlink(ck._commit_path(s))   # simulate the legacy layout
+        tr2 = mk()
+        assert ck.restore_latest(tr2) == 2
+
+
+def test_async_saves_commit_at_the_next_boundary(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    with TrainerCheckpoint(tmp_path / "ck", async_save=True) as ck:
+        for s in (1, 2, 3):
+            tr.step(x, y)
+            ck.save(s, tr)                  # async, no wait
+        ck.wait_until_finished()
+        assert ck.committed_steps() == [1, 2, 3]
+        tr2 = mk()
+        assert ck.restore_latest(tr2) == 3
+
+
+def test_commit_barrier_fences_the_marker(tmp_path):
+    """The commit barrier runs before the marker write — the two-phase
+    ordering every rank relies on."""
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    mk, x, y = _mini_rig()
+    tr = mk()
+    order = []
+
+    def barrier():
+        # at barrier time the marker must not exist yet
+        order.append(os.path.exists(ck._commit_path(1)))
+
+    with TrainerCheckpoint(tmp_path / "ck",
+                           commit_barrier=barrier) as ck:
+        tr.step(x, y)
+        ck.save(1, tr, wait=True)
+        assert order == [False]
+        assert os.path.exists(ck._commit_path(1))
+
+
+# -- kill_stale SUPERVISED tag -------------------------------------------
+
+def _kill_stale(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "kill_stale.py")]
+        + list(args), capture_output=True, text=True, timeout=120)
+
+
+def _supervised_sleeper(gang_dir):
+    """A candidate process (cmdline mentions mxnet_tpu) tagged as a
+    supervised gang worker via MXTPU_GANG_DIR in its environment."""
+    env = dict(os.environ, MXTPU_GANG_DIR=gang_dir)
+    return subprocess.Popen(
+        [sys.executable, "-S", "-c", "import time; time.sleep(600)",
+         "mxnet_tpu-gang-worker"], env=env)
+
+
+def _write_supervisor_record(gang_dir, pid, heartbeat_age=0.0):
+    from mxnet_tpu.resilience.lease import _boot_id, _proc_starttime
+    rec = {"what": "gang-supervisor", "pid": pid,
+           "host": socket.gethostname(), "boot_id": _boot_id(),
+           "starttime": _proc_starttime(pid) if pid else 1,
+           "nranks": 2, "created": time.time() - heartbeat_age - 1,
+           "heartbeat": time.time() - heartbeat_age}
+    with open(os.path.join(gang_dir, "supervisor.json"), "w") as f:
+        f.write(json.dumps(rec))
+
+
+def test_kill_stale_refuses_supervised_worker(tmp_path):
+    """A gang whose supervisor is alive is never reaped: killing a
+    worker only triggers a supervisor restart. Exit 2 tells callers
+    recovery is blocked (the lease-holder contract)."""
+    d = str(tmp_path)
+    lease = os.path.join(d, "none.lease")   # isolate from any real lease
+    _write_supervisor_record(d, os.getpid())  # us: alive, fresh
+    w = _supervised_sleeper(d)
+    try:
+        time.sleep(0.3)
+        r = _kill_stale("--kill", "--lease-path", lease)
+        assert "SUPERVISED" in r.stdout
+        assert "refused (supervised worker" in r.stdout
+        assert r.returncode == 2, r.stdout + r.stderr
+        assert w.poll() is None             # still alive
+    finally:
+        w.kill()
+        w.wait()
+
+
+def test_kill_stale_dead_supervisor_removes_protection(tmp_path):
+    """Supervisor gone (dead pid + stale heartbeat): the worker is an
+    ordinary candidate again, not SUPERVISED."""
+    d = str(tmp_path)
+    lease = os.path.join(d, "none.lease")
+    _write_supervisor_record(d, 2 ** 22 + 1, heartbeat_age=1000.0)
+    w = _supervised_sleeper(d)
+    try:
+        time.sleep(0.3)
+        r = _kill_stale("--lease-path", lease)   # list mode
+        lines = [ln for ln in r.stdout.splitlines()
+                 if "pid %d " % w.pid in ln]
+        assert lines and "SUPERVISED" not in lines[0], r.stdout
+    finally:
+        w.kill()
+        w.wait()
+
+
+# -- supervision telemetry in the report ---------------------------------
+
+def test_telemetry_report_supervision_section(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import telemetry_report
+    recs = [
+        {"step_time": 0.1, "batch_size": 4},
+        {"source": "resilience", "event": "rank_lost", "rank": 2,
+         "step_time": 0.0},
+        {"source": "resilience", "event": "gang_restart", "rank": 2,
+         "step_time": 1.5, "restarts": 1},
+        {"source": "resilience", "event": "ckpt_commit", "step": 3,
+         "step_time": 0.02},
+    ]
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    s = telemetry_report.summarize(telemetry_report.load_records(
+        str(path)))
+    assert s["steps"] == 1                 # headline excludes resilience
+    assert s["ranks_lost"] == 1 and s["ranks_lost_set"] == [2]
+    assert s["gang_restarts"] == 1
+    assert abs(s["gang_downtime_s"] - 1.5) < 1e-9
+    assert s["ckpt_commits"] == 1
+    text = telemetry_report.format_summary(s)
+    assert "supervision" in text and "ckpt commit" in text

@@ -15,6 +15,15 @@ Usage:
         [--seed 7] [--timeout 900] [--expect complete|error|either] \
         -- python train.py ...
 
+Gang-kill mode (`--kill-rank R --after-steps K`): instead of a global
+spec, arm the `worker.kill` chaos site on ONE rank of a supervised
+gang — the wrapped command is typically `tools/launch.py --supervise
+-n N ...`. The spec rides `MXTPU_CHAOS_RANK_<R>` (read only by rank R,
+stripped from relaunched generations by the GangSupervisor, so the
+injected death happens exactly once), and rank R SIGKILLs itself at
+training-step boundary K+1 — the end-to-end gang-restart proof
+(docs/fault_tolerance.md).
+
 Exit codes: 0 outcome matched --expect; 2 outcome mismatched; 3 hang.
 Runnable from the bench harness (plain argv contract, single JSON
 summary line on stdout).
@@ -46,9 +55,17 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run a command under MXTPU_CHAOS with a no-hang "
                     "watchdog")
-    ap.add_argument("--chaos", required=True,
+    ap.add_argument("--chaos", default=None,
                     help="MXTPU_CHAOS spec, e.g. "
                          "'kvstore.push:p=0.1,kind=raise;io.read:p=0.05'")
+    ap.add_argument("--kill-rank", type=int, default=None,
+                    help="arm worker.kill (kind=kill) on this rank only "
+                         "via MXTPU_CHAOS_RANK_<R> — the gang-restart "
+                         "chaos mode")
+    ap.add_argument("--after-steps", type=int, default=0,
+                    help="with --kill-rank: survive this many training "
+                         "steps before the SIGKILL (default 0: die at "
+                         "the first step boundary)")
     ap.add_argument("--seed", type=int, default=0,
                     help="MXTPU_CHAOS_SEED for the child (default 0)")
     ap.add_argument("--timeout", type=float, default=900.0,
@@ -66,15 +83,24 @@ def main(argv=None):
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         ap.error("no command given (put it after --)")
+    if args.chaos is None and args.kill_rank is None:
+        ap.error("need --chaos and/or --kill-rank")
+    if args.kill_rank is not None and args.kill_rank < 0:
+        ap.error("--kill-rank must be a non-negative rank id")
 
     # validate the spec HERE: a typo'd spec silently injecting nothing
     # would report a meaningless pass
     from mxnet_tpu.resilience.chaos import parse_spec
-    sites = sorted(parse_spec(args.chaos))
-
-    env = dict(os.environ,
-               MXTPU_CHAOS=args.chaos,
-               MXTPU_CHAOS_SEED=str(args.seed))
+    env = dict(os.environ, MXTPU_CHAOS_SEED=str(args.seed))
+    sites = []
+    if args.chaos is not None:
+        sites += sorted(parse_spec(args.chaos))
+        env["MXTPU_CHAOS"] = args.chaos
+    if args.kill_rank is not None:
+        kill_spec = "worker.kill:kind=kill,after=%d" % max(
+            0, args.after_steps)
+        sites += sorted(parse_spec(kill_spec))
+        env["MXTPU_CHAOS_RANK_%d" % args.kill_rank] = kill_spec
     t0 = time.time()
     p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
@@ -93,11 +119,46 @@ def main(argv=None):
     ok = {"complete": outcome == "COMPLETED",
           "error": outcome == "CLEAN_ERROR",
           "either": outcome in ("COMPLETED", "CLEAN_ERROR")}[args.expect]
-    print(json.dumps({"outcome": outcome, "ok": ok,
-                      "rc": p.returncode, "hung": hung,
-                      "elapsed_s": round(time.time() - t0, 2),
-                      "chaos_sites": sites,
-                      "tail": tail[-2000:]}))
+    summary = {"outcome": outcome, "ok": ok,
+               "rc": p.returncode, "hung": hung,
+               "elapsed_s": round(time.time() - t0, 2),
+               "chaos_sites": sites,
+               "tail": tail[-2000:]}
+    if args.kill_rank is not None and outcome == "COMPLETED":
+        # a kill that never fired (rank id outside the gang, site
+        # unreached) completing "cleanly" is the meaningless pass the
+        # spec validation above exists to prevent — when the command
+        # was a supervised gang, its GANG_REPORT line proves the
+        # injection actually caused a restart
+        reports = [ln for ln in (out or "").splitlines()
+                   if ln.startswith("GANG_REPORT ")]
+        if not reports:
+            # a COMPLETED run with no supervised gang at all proves
+            # nothing either: an unsupervised command with no rank env
+            # never reads MXTPU_CHAOS_RANK_* (a supervised gang that
+            # WAS killed without recovering would not have COMPLETED)
+            ok = summary["ok"] = False
+            summary["note"] = ("--kill-rank %d unproven: the command "
+                               "completed but emitted no GANG_REPORT "
+                               "— wrap the command in tools/launch.py "
+                               "--supervise so the injection and the "
+                               "recovery are both observable"
+                               % args.kill_rank)
+        else:
+            try:
+                restarts = json.loads(
+                    reports[-1][len("GANG_REPORT "):]).get("restarts", 0)
+            except ValueError:
+                restarts = None
+            summary["gang_restarts"] = restarts
+            if not restarts:
+                ok = summary["ok"] = False
+                summary["note"] = ("--kill-rank %d never fired: the "
+                                   "gang completed with 0 restarts "
+                                   "(rank id outside the gang, or the "
+                                   "worker.kill site was never "
+                                   "reached)" % args.kill_rank)
+    print(json.dumps(summary))
     if outcome == "HANG":
         return 3
     return 0 if ok else 2

@@ -21,6 +21,16 @@ Usage:
     python tools/kill_stale.py --kill --force --expired
                                           # even a fresh lease holder
 
+Supervised gangs (resilience/supervisor.py, ISSUE 8) are recognized by
+the MXTPU_GANG_DIR tag in a candidate's environment: when the gang's
+supervisor is alive (pid + starttime + boot id from
+<gang_dir>/supervisor.json, heartbeat fresh), the worker is tagged
+SUPERVISED and NEVER reaped — killing it would only trigger a
+supervisor restart (reap the supervisor instead if the gang itself is
+the problem). A refused supervised worker exits 2 like a refused lease
+holder. Workers whose supervisor is dead fall through to the normal
+heuristics.
+
 The on-disk device lease (mxnet_tpu/resilience/lease.py, ISSUE 7) is
 read FIRST and is ground truth over every /proc heuristic:
 
@@ -131,6 +141,30 @@ def _read(path):
         return ""
 
 
+def gang_state(pid):
+    """(gang_dir, supervisor_alive) for a supervised worker: the gang
+    dir comes from MXTPU_GANG_DIR in the candidate's environment, and
+    the supervisor record from <gang_dir>/supervisor.json — the same
+    identity/heartbeat record shape as the device lease, so liveness
+    and freshness reuse `lease_state` verbatim (one pid-reuse defense,
+    not two). Alive means the recorded pid still exists with the
+    recorded starttime AND its heartbeat is fresh; a foreign-host
+    record can only be aged out by its own heartbeat — a stale record
+    from a reimaged host must not protect orphan workers forever. A
+    dead or silent supervisor protects nothing."""
+    gdir = None
+    for chunk in _read("/proc/%d/environ" % pid).split("\0"):
+        if chunk.startswith("MXTPU_GANG_DIR="):
+            gdir = chunk.split("=", 1)[1] or None
+    if gdir is None:
+        return None, False
+    rec, fresh, alive = lease_state(os.path.join(gdir,
+                                                 "supervisor.json"))
+    if rec is None:
+        return gdir, False
+    return gdir, alive and fresh
+
+
 def _ancestors_of_self():
     pids = set()
     pid = os.getpid()
@@ -205,8 +239,11 @@ def find_candidates(init_grace=600, lease_path=None):
         init_hung = (age is not None and cpu_s is not None
                      and age > init_grace and cpu_s < 10.0
                      and cpu_s < 0.05 * age)
+        gdir, sup_alive = gang_state(pid)
         out.append({
             "pid": pid, "cmd": cmdline[:160],
+            "gang_dir": gdir,
+            "supervised": sup_alive,
             "age_s": round(age, 1) if age is not None else -1.0,
             "cpu_s": round(cpu_s, 1) if cpu_s is not None else -1.0,
             "accel_mapped": maps_has_accel,
@@ -252,8 +289,11 @@ def main(argv=None):
         return 0
     killed = 0
     blocked = 0
+    supervised_blocked = 0
     for c in cands:
-        if c["lease_holder"]:
+        if c["supervised"]:
+            tag = "SUPERVISED"
+        elif c["lease_holder"]:
             tag = "LEASE-HOLDER" if c["lease_fresh"] else "LEASE-EXPIRED"
         elif c["lease_risk"]:
             tag = "ACCEL-MAPPED"
@@ -265,6 +305,16 @@ def main(argv=None):
               % (c["pid"], "%.0fs" % c["age_s"], "%.1fs" % c["cpu_s"],
                  tag, c["cmd"]))
         if not args.kill:
+            continue
+        if c["supervised"]:
+            # the supervisor owns this worker's lifecycle: killing it
+            # only triggers a gang restart — never a recovery. Reap the
+            # SUPERVISOR if the gang itself is the problem.
+            print("  -> refused (supervised worker, gang supervisor "
+                  "alive in %s; kill the supervisor to stop the gang)"
+                  % c["gang_dir"])
+            blocked += 1
+            supervised_blocked += 1
             continue
         if c["lease_fresh"] and not (args.force and args.expired):
             # lease ground truth: a fresh heartbeat means the holder is
@@ -304,10 +354,12 @@ def main(argv=None):
         print("lease %s: live holder on host %s — cannot recover from "
               "here" % (lease_path, lrec["host"]))
         blocked += 1
-    if args.kill and lrec is not None and not blocked:
+    if args.kill and lrec is not None \
+            and blocked == supervised_blocked:
         # holder dead (was dead, or reaped above): clear the orphan
         # lease so the next acquire wins O_EXCL immediately instead of
-        # waiting out the takeover window
+        # waiting out the takeover window. A refused SUPERVISED worker
+        # does not block the clear — it says nothing about the lease.
         if killed:
             time.sleep(0.2)   # let a just-SIGKILLed holder leave /proc
         _, _, still_alive = lease_state(lease_path)
@@ -320,8 +372,8 @@ def main(argv=None):
     if args.kill:
         print("kill_stale: killed %d/%d" % (killed, len(cands)))
         if blocked:
-            print("kill_stale: %d live lease holder(s) refused — "
-                  "recovery blocked" % blocked)
+            print("kill_stale: %d live lease holder(s)/supervised "
+                  "worker(s) refused — recovery blocked" % blocked)
             return 2
     else:
         print("kill_stale: %d candidate(s) listed (no --kill)" % len(cands))

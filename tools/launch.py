@@ -18,9 +18,19 @@ Modes:
   local (default): all workers on this host.
   ssh: one worker per line of --hostfile (requires passwordless ssh;
        reference ssh mode).
+  --supervise: local workers run under a resilience.GangSupervisor —
+       any rank death tears down the stragglers and relaunches the
+       gang from the latest committed checkpoint, with bounded
+       restarts (--max-restarts / MXTPU_MAX_RESTARTS) and exponential
+       backoff (--restart-backoff / MXTPU_RESTART_BACKOFF_S). The
+       supervisor tags its children (MXTPU_SUPERVISED=1 +
+       MXTPU_GANG_DIR) so tools/kill_stale.py refuses to reap a gang
+       whose supervisor is alive, and writes a restart/downtime
+       report to <gang-dir>/report.json (docs/fault_tolerance.md).
 
 Usage:
   tools/launch.py -n 4 python train.py --kv-store dist_sync
+  tools/launch.py -n 4 --supervise python train.py --kv-store dist_sync
   tools/launch.py -H hostfile --cleanup --kill  # cluster stale reap
                                             # (reference kill-mxnet.py)
 """
@@ -42,6 +52,10 @@ def _free_port():
 
 
 def _worker_env(base, coordinator, n, rank):
+    # rendezvous env contract mirrored by resilience/supervisor.py's
+    # _rank_environ (which adds the gang tags): this tool stays
+    # stdlib-only for plain -n mode, so the block is duplicated on
+    # purpose — change BOTH or ranks will disagree on their identity
     env = dict(base)
     env.update({
         "DMLC_ROLE": "worker",
@@ -89,6 +103,31 @@ def launch_local(n, command, env=None):
         rc = 130
     for t in pumps:
         t.join(timeout=2)
+    return rc
+
+
+def launch_supervised(n, command, gang_dir=None, max_restarts=None,
+                      backoff_s=None):
+    """Run n local workers under a GangSupervisor (elastic gang
+    supervision, docs/fault_tolerance.md): rank death -> straggler
+    teardown -> bounded relaunch from the latest committed checkpoint.
+    Returns the gang's final exit code and prints one GANG_REPORT JSON
+    line for harnesses."""
+    import json
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.resilience.supervisor import GangSupervisor
+    sup = GangSupervisor(command, n, gang_dir=gang_dir,
+                         max_restarts=max_restarts, backoff_s=backoff_s)
+    rc = sup.run()
+    print("GANG_REPORT %s" % json.dumps(
+        dict(sup.report(), exit_code=rc), sort_keys=True))
+    sys.stdout.flush()
+    if rc < 0:
+        # a Popen signal code (-9) would exit as a meaningless 247
+        # after the mod-256 wrap; use the shell convention 128+sig so
+        # harnesses see a sane status alongside the 0/75/76 contract
+        rc = 128 - rc
     return rc
 
 
@@ -156,6 +195,22 @@ def main():
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh"])
     parser.add_argument("-H", "--hostfile", default=None)
+    parser.add_argument("--supervise", action="store_true",
+                        help="run local workers under a GangSupervisor:"
+                             " rank death => straggler teardown +"
+                             " bounded relaunch from the latest"
+                             " committed checkpoint")
+    parser.add_argument("--gang-dir", default=None,
+                        help="with --supervise: gang state dir"
+                             " (heartbeats, supervisor record,"
+                             " report.json); default under $TMPDIR")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        help="with --supervise: gang relaunch budget"
+                             " (default MXTPU_MAX_RESTARTS or 3)")
+    parser.add_argument("--restart-backoff", type=float, default=None,
+                        help="with --supervise: first restart backoff"
+                             " seconds, doubled per incident (default"
+                             " MXTPU_RESTART_BACKOFF_S or 1.0)")
     parser.add_argument("--cleanup", action="store_true",
                         help="list (with --kill: reap) stale framework "
                              "processes on this host and every "
@@ -172,7 +227,14 @@ def main():
         parser.error("-n/--num-workers is required (unless --cleanup)")
     if not args.command:
         parser.error("no command given")
-    if args.launcher == "local":
+    if args.supervise:
+        if args.launcher != "local":
+            parser.error("--supervise implies the local launcher")
+        rc = launch_supervised(args.num_workers, args.command,
+                               gang_dir=args.gang_dir,
+                               max_restarts=args.max_restarts,
+                               backoff_s=args.restart_backoff)
+    elif args.launcher == "local":
         rc = launch_local(args.num_workers, args.command)
     else:
         rc = launch_ssh(_read_hostfile(args.hostfile),

@@ -220,6 +220,32 @@ def summarize(records):
         if trips:
             summary["watchdog_trip_kinds"] = sorted(
                 {str(r.get("kind", "?")) for r in trips})
+        # supervision subsection (docs/fault_tolerance.md): gang events
+        # — rank_lost (a peer proved dead), gang_restart (supervisor
+        # relaunch, step_time = downtime), ckpt_commit (two-phase
+        # checkpoint commit, step_time = barrier+manifest wall time)
+        lost = [r for r in res if r.get("event") == "rank_lost"]
+        restarts = [r for r in res if r.get("event") == "gang_restart"]
+        commits = sorted(float(r["step_time"]) for r in res
+                         if r.get("event") == "ckpt_commit")
+        if lost or restarts:
+            # every survivor emits its own rank_lost for the same dead
+            # peer (plus the supervisor's) — dedup by rank so one dead
+            # rank in an N-rank gang is not reported as N losses
+            ranks = sorted({int(r["rank"]) for r in lost
+                            if isinstance(r.get("rank"), (int, float))})
+            summary["ranks_lost"] = len(ranks)
+            summary["ranks_lost_set"] = ranks
+            summary["rank_lost_events"] = len(lost)
+            summary["gang_restarts"] = len(restarts)
+            down = [float(r["step_time"]) for r in restarts]
+            if down:
+                summary["gang_downtime_s"] = sum(down)
+                summary["gang_downtime_max_s"] = max(down)
+        if commits:
+            summary["ckpt_commits"] = len(commits)
+            summary["ckpt_commit_p95_s"] = _percentile(commits, 0.95)
+            summary["ckpt_commit_total_s"] = sum(commits)
     return summary
 
 
@@ -319,6 +345,22 @@ def format_summary(s):
             lines.append("  watchdog    %d trips (%s)"
                          % (s["watchdog_trips"],
                             ", ".join(s.get("watchdog_trip_kinds", []))))
+    if "ranks_lost" in s or "ckpt_commits" in s:
+        if s.get("ranks_lost") or s.get("gang_restarts"):
+            lines.append(
+                "  supervision %d rank(s) lost %s  %d gang restart(s)"
+                "%s"
+                % (s.get("ranks_lost", 0),
+                   s.get("ranks_lost_set", []),
+                   s.get("gang_restarts", 0),
+                   ("  downtime %.3fs (max %.3fs)"
+                    % (s["gang_downtime_s"], s["gang_downtime_max_s"])
+                    if "gang_downtime_s" in s else "")))
+        if s.get("ckpt_commits"):
+            lines.append(
+                "  ckpt commit %d commits  p95 %.4fs  total %.3fs"
+                % (s["ckpt_commits"], s["ckpt_commit_p95_s"],
+                   s["ckpt_commit_total_s"]))
     return "\n".join(lines)
 
 
