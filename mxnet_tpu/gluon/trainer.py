@@ -21,6 +21,7 @@ from __future__ import annotations
 from .. import optimizer as opt
 from ..kvstore import create as _create_kvstore
 from ..observability.telemetry import StepTimer
+from ..resilience import numerics as _numerics
 from ..resilience.atomic import atomic_write
 from ..resilience.preempt import at_step_boundary
 from .parameter import ParameterDict, Parameter
@@ -65,6 +66,14 @@ class Trainer:
         self._optimizer = self._make_optimizer(optimizer, opt_kw)
         self._updaters = [opt.get_updater(self._optimizer)]
         self._telemetry = StepTimer("gluon.trainer")
+        # training numerics guard (default on, ISSUE 10): resolves the
+        # fused update's in-graph skip flags at each step boundary,
+        # drives the loss-scale schedule, and arms divergence rollback
+        # when a checkpoint is attached (docs/fault_tolerance.md)
+        self._numerics = (_numerics.NumericsGuard(source="gluon.trainer")
+                          if _numerics.enabled() else None)
+        self._scaler = None          # armed lazily via scale_loss()
+        self._last_grads = None
 
     # -- construction ---------------------------------------------------
     def _make_optimizer(self, optimizer, opt_kw):
@@ -121,6 +130,33 @@ class Trainer:
                               "learning rate is mutated.")
         self._optimizer.set_learning_rate(lr)
 
+    @property
+    def numerics(self):
+        """The trainer's NumericsGuard (None with MXTPU_NUMERICS=0).
+        Training loops feed the divergence watchdog through it
+        (``trainer.numerics.note(loss=...)``) and arm rollback/replay
+        (``attach_rollback`` / ``attach_replay``)."""
+        return self._numerics
+
+    def scale_loss(self, loss):
+        """Dynamic loss scaling for fp16/bf16 lanes (GradScaler shape,
+        docs/fault_tolerance.md): returns ``loss * scale`` for the
+        backward pass and ARMS the scaler — from then on `step()`
+        folds ``1/scale`` into rescale_grad (unscaling in the fused
+        kernel, no extra pass) and the guard's overflow verdicts drive
+        the halve-on-overflow / grow-after-`MXTPU_SCALE_WINDOW`
+        schedule. Unscaled runs never arm it, so the default-on guard
+        cannot change their numerics."""
+        if self._scaler is None:
+            self._scaler = _numerics.GradScaler()
+            if self._numerics is not None:
+                self._numerics.scaler = self._scaler
+        return self._scaler.scale_loss(loss)
+
+    @property
+    def loss_scale(self):
+        return self._scaler.scale if self._scaler is not None else 1.0
+
     # -- the step -------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: reduce grads, then update params
@@ -132,12 +168,36 @@ class Trainer:
         self._ensure_ready()
         tel = self._telemetry
         tel.begin_step()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = self._rescale(batch_size)
         with tel.phase("allreduce"):
             self._reduce()
         with tel.phase("optimizer"):
             self._apply_updates(ignore_stale_grad)
+        self._numerics_boundary(tel)
         tel.end_step(batch_size=batch_size)
+
+    def _rescale(self, batch_size):
+        """rescale_grad for this step: the caller's scale over the
+        batch, divided by the loss scale when the scaler is armed (the
+        unscale rides the fused update kernel for free)."""
+        scale = self._scale / batch_size
+        if self._scaler is not None and self._scaler.armed:
+            scale *= self._scaler.unscale_factor()
+        return scale
+
+    def _numerics_boundary(self, tel=None):
+        """Resolve this step's in-graph skip flags: metric/telemetry
+        accounting, loss-scale schedule, SDC replay on first anomaly,
+        divergence watchdog (may raise TrainingDiverged after
+        rollback)."""
+        if self._numerics is None:
+            return
+        grads, self._last_grads = self._last_grads, None
+        if tel is not None:
+            with tel.phase("numerics"):
+                self._numerics.step_boundary(step=tel.step, grads=grads)
+        else:
+            self._numerics.step_boundary(grads=grads)
 
     def allreduce_grads(self):
         """Reduce gradients over devices/workers without updating
@@ -155,8 +215,9 @@ class Trainer:
         assert not (self._kvstore and self._update_via_kv), \
             "update() when parameters are updated on kvstore is not " \
             "supported."
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = self._rescale(batch_size)
         self._apply_updates(ignore_stale_grad)
+        self._numerics_boundary()
 
     def _reduce(self):
         if not self._reduce_via_kv:
@@ -203,6 +264,10 @@ class Trainer:
         weights = [p.data() for _, p in pairs]
         for updater in self._updaters:
             updater.update_all(idxs, grads, weights)
+        if self._numerics is not None:
+            # kept for the boundary's SDC replay digest (grads are not
+            # donated — the arrays stay valid until the next backward)
+            self._last_grads = grads
         for g in grads:
             g._fresh_grad = False
 

@@ -18,6 +18,7 @@ from ..model import BatchEndParam
 from ..initializer import Uniform
 from ..ndarray import NDArray
 from ..observability.telemetry import StepTimer
+from ..resilience import numerics as _numerics
 from ..resilience.preempt import at_step_boundary
 
 
@@ -265,10 +266,31 @@ class BaseModule:
                 if step_timer is None:
                     step_timer = StepTimer("module.fit")
                 step_timer.begin_step()
+                # pre-forward RNG key: the SDC replay must reproduce
+                # the ORIGINAL forward's random draws (dropout masks),
+                # so it rewinds to this key — saving the post-step key
+                # would give the replay different masks and misclassify
+                # every healthy anomaly as hardware SDC
+                from .. import random as _random
+                self._numerics_prestep_key = _random.current_key()
                 with step_timer.phase("forward_backward"):
                     self.forward_backward(batch)
                 with step_timer.phase("optimizer"):
                     self.update()
+                # numerics boundary (ISSUE 10): resolve the fused
+                # update's in-graph skip flags; on the first anomaly
+                # the guard replays THIS batch deterministically from
+                # the skip-preserved pre-step weights to classify
+                # hardware SDC vs data. May raise TrainingDiverged
+                # (after rollback) — ends the fit like a preemption
+                guard = self._numerics_guard()
+                if guard is not None:
+                    if _numerics.sdc_replay_enabled():
+                        guard.attach_replay(
+                            lambda b=batch: self._numerics_replay(b))
+                    with step_timer.phase("numerics"):
+                        guard.step_boundary(step=step_timer.step,
+                                            grads=self._numerics_grads())
                 # step boundary: a pending SIGTERM checkpoints (via an
                 # active PreemptionGuard) and stops the fit loop here,
                 # after the update made state consistent
@@ -299,6 +321,56 @@ class BaseModule:
             # pulling from the shared underlying iterator
             staged.close()
         return final_metrics
+
+    # -- numerics guard plumbing (resilience/numerics.py) ---------------
+    def _numerics_guard(self):
+        """This module's NumericsGuard, created on first use (None with
+        MXTPU_NUMERICS=0). `module.numerics` is the public handle for
+        loops that want to feed the divergence watchdog
+        (`guard.note(loss=...)`) or arm rollback."""
+        guard = getattr(self, "_numerics_guard_obj", None)
+        if guard is None and _numerics.enabled():
+            guard = self._numerics_guard_obj = _numerics.NumericsGuard(
+                source="module.fit")
+        return guard
+
+    @property
+    def numerics(self):
+        return self._numerics_guard()
+
+    def _numerics_grads(self):
+        """Flat list of this module's gradient arrays (for the SDC
+        replay digest), or None when the executor group is absent
+        (python/sequential modules)."""
+        eg = getattr(self, "_exec_group", None)
+        ga = getattr(eg, "grad_arrays", None) if eg is not None else None
+        if not ga:
+            return None
+        out = []
+        for per_key in ga:
+            arrs = per_key if isinstance(per_key, (list, tuple)) \
+                else [per_key]
+            out.extend(a for a in arrs if a is not None)
+        return out or None
+
+    def _numerics_replay(self, batch):
+        """Deterministic re-run of one batch's gradient computation:
+        the skip preserved the pre-step weights bit-identically, and
+        the global RNG key is REWOUND to the value captured before the
+        original forward (so dropout masks replay exactly), then
+        restored — the ONLY way the recomputed gradients can differ
+        bit-for-bit from the originals is corruption in the original
+        run, the hardware-SDC signature."""
+        from .. import random as _random
+        prestep = getattr(self, "_numerics_prestep_key", None)
+        saved = _random.current_key()
+        try:
+            if prestep is not None:
+                _random._state.key = prestep
+            self.forward_backward(batch)
+        finally:
+            _random._state.key = saved
+        return self._numerics_grads()
 
     # ------------------------------------------------------------------
     # parameters

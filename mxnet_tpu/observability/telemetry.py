@@ -203,6 +203,10 @@ def _counters_snapshot():
         "fused_groups": _counter_total("optimizer.fused.groups"),
         "fused_pack_seconds": fused_pack_s,
         "fused_update_seconds": fused_update_s,
+        # numerics guard (resilience/numerics.py): per-step deltas let
+        # tools/perf_gate.py fail a silently-skipping run
+        "skipped_steps": _counter_total("numerics.skipped_steps"),
+        "anomalies": _counter_total("numerics.anomalies"),
     }
 
 
@@ -305,10 +309,16 @@ class StepTimer:
                       "bucket_fill_sum", "bucket_pack_seconds",
                       "bucket_unpack_seconds", "update_dispatches",
                       "fused_groups", "fused_pack_seconds",
-                      "fused_update_seconds"):
+                      "fused_update_seconds", "skipped_steps",
+                      "anomalies"):
             delta = snap[field] - prev.get(field, 0)
             if delta:
                 record[field] = delta
+        # current loss scale rides along once a GradScaler armed it —
+        # a gauge, not a delta (absent on unscaled runs)
+        scale_gauge = REGISTRY.get("numerics.loss_scale")
+        if scale_gauge is not None and scale_gauge.labelsets():
+            record["loss_scale"] = scale_gauge.get()
         for name, secs in self._phases.items():
             record[name + "_time"] = secs
         self._phases = {}

@@ -43,7 +43,7 @@ from ..base import getenv
 from ..observability import registry as _obs
 
 __all__ = ["GradBucketer", "Bucket", "DEFAULT_BUCKET_MB",
-           "bucket_target_bytes"]
+           "bucket_target_bytes", "finite_all"]
 
 DEFAULT_BUCKET_MB = 4.0
 
@@ -70,6 +70,21 @@ def bucket_target_bytes():
     disables bucketing."""
     mb = getenv("MXTPU_BUCKET_MB", DEFAULT_BUCKET_MB)
     return int(max(0.0, float(mb)) * (1 << 20))
+
+
+_FINITE_JIT = []   # one jitted wrapper; jax.jit caches per shape/dtype
+
+
+def finite_all(flat):
+    """Device-side all-finite verdict over one packed fusion buffer:
+    returns a 0-d bool array WITHOUT a host sync — the numerics guard's
+    per-bucket anomaly probe (resilience/numerics.py), piggybacked on
+    buffers the exchange already packed. Resolution to a Python bool
+    happens later, at the guard's step boundary."""
+    import jax
+    if not _FINITE_JIT:
+        _FINITE_JIT.append(jax.jit(lambda a: jnp.isfinite(a).all()))
+    return _FINITE_JIT[0](flat)
 
 
 class Bucket:

@@ -476,6 +476,25 @@ class TrainerCheckpoint:
             "no complete readable checkpoint among steps %s in %s"
             % (sorted(steps), self._dir)) from last_err
 
+    def drop_steps_after(self, step):
+        """Drop every saved step NEWER than `step` — committed or not —
+        and return the dropped step numbers (ascending). The numerics
+        guard's divergence rollback (resilience/numerics.py): a
+        diverged run's newest checkpoints captured the post-divergence
+        weights, so resuming from them would replay the divergence; the
+        guard drops everything newer than the last *trusted* step
+        before restoring. Primary rank only (non-primary managers are
+        restore-side readers and must not race the deletion)."""
+        self._finalize_pending()
+        dropped = []
+        if not self._primary:
+            return dropped
+        for s in sorted(self._mngr.all_steps()):
+            if s > step:
+                self._drop_step(s)
+                dropped.append(int(s))
+        return dropped
+
     def _drop_step(self, step):
         """Remove a rejected (torn/corrupt) step from disk and from
         orbax's step cache. Best-effort: a failure to delete only

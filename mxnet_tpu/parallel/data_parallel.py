@@ -36,6 +36,7 @@ from ..ndarray import NDArray
 from .. import symbol as _sym
 from ..graph import build_graph_fn, collect_vars
 from .. import random as _random
+from ..resilience import numerics as _num
 from ..resilience.preempt import at_step_boundary
 from .mesh import make_mesh, replicated, current_mesh
 
@@ -87,6 +88,17 @@ def adam_update(params, grads, state, lr=0.001, beta1=0.9, beta2=0.999,
         new_v[k] = v
         new_p[k] = p - lr * mhat / (jnp.sqrt(vhat) + eps)
     return new_p, {"m": new_m, "v": new_v, "t": t}
+
+
+def _grads_finite(grads):
+    """In-graph all-finite verdict over a gradient pytree (numerics
+    guard, ISSUE 10): one fused reduction per leaf, stacked into a 0-d
+    bool — XLA folds it into the step program, so detection costs no
+    extra dispatch and no host round-trip."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.all(jnp.stack([jnp.isfinite(g).all() for g in leaves]))
 
 
 # defaults match mx.optimizer's SGD/Adam (optimizer.py): momentum 0
@@ -297,15 +309,27 @@ class ShardedTrainer:
         return self._batch_sharding(ndim)
 
     # -- compiled step --------------------------------------------------
-    def _make_step_body(self):
+    def _make_step_body(self, guarded=None):
         """The pure per-step function (params, aux, opt_state, inputs,
-        key) -> (params', aux', opt_state', loss), shared by the
-        single-step jit and the scanned multi-step program."""
+        key) -> (params', aux', opt_state', loss, ok), shared by the
+        single-step jit and the scanned multi-step program. `ok` is the
+        numerics guard's in-graph verdict: with MXTPU_NUMERICS (read at
+        trace time) a step whose gradients are not all finite is
+        SKIPPED — params/aux/opt state pass through bit-identical via
+        `jnp.where` — and `ok` reports it; with the guard off `ok` is a
+        constant True and the jaxpr is exactly the pre-guard one.
+
+        `guarded=False` forces the unguarded body regardless of the
+        env: the scanned multi-step program uses it — a few hundred
+        selects inside a `lax.scan` body blow XLA's CPU compile up by
+        an order of magnitude (measured on inception-v3), so
+        `step_many` guards the WINDOW outside the loop instead."""
         fn = self._fn
         opt_update = self._opt_update
         hp = self._opt_hp
         cd = self._compute_dtype
         data_names = set(self._data_names)
+        guard = _num.enabled() if guarded is None else bool(guarded)
 
         def step(params, aux, opt_state, inputs, key):
             if cd is not None:
@@ -329,7 +353,17 @@ class ShardedTrainer:
                                                **hp)
             new_aux = dict(aux)
             new_aux.update(auxup or {})
-            return new_params, new_aux, new_state, loss
+            if guard:
+                ok = _grads_finite(grads)
+                keep = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+                new_params = jax.tree.map(keep, new_params, params)
+                new_state = jax.tree.map(keep, new_state, opt_state)
+                # aux (BN stats) updated by a poisoned forward are
+                # suspect too: the skip preserves them with the rest
+                new_aux = jax.tree.map(keep, new_aux, dict(aux))
+            else:
+                ok = jnp.bool_(True)
+            return new_params, new_aux, new_state, loss, ok
 
         return step
 
@@ -369,7 +403,7 @@ class ShardedTrainer:
         self._step_fn = jax.jit(
             step,
             in_shardings=(param_sh, aux_sh, opt_sh, in_sh, None),
-            out_shardings=(param_sh, aux_sh, opt_sh, rep),
+            out_shardings=(param_sh, aux_sh, opt_sh, rep, rep),
             donate_argnums=(0, 1, 2))
 
     def _build_step_many(self):
@@ -379,8 +413,15 @@ class ShardedTrainer:
         on high-latency links (dev tunnels, multi-host controllers) the
         per-call round trip amortizes away; on any TPU it removes K-1
         host dispatches."""
-        body = self._make_step_body()
+        # the scan body is UNGUARDED (see _make_step_body: per-step
+        # selects inside the while loop explode XLA compile); the
+        # window is guarded once OUTSIDE the loop instead — a NaN step
+        # poisons the rest of the window exactly like the pre-guard
+        # behavior, but the window's verdict is still recorded, so a
+        # poisoned benchmark window can never post a silent number
+        body = self._make_step_body(guarded=False)
         needs_rng = self._needs_rng
+        guard = _num.enabled()
 
         def many(params, aux, opt_state, inputs, key, n_steps, unroll):
             def scan_body(carry, _):
@@ -389,19 +430,30 @@ class ShardedTrainer:
                     key, sub = jax.random.split(key)
                 else:
                     sub = None
-                params, aux, opt_state, loss = body(params, aux,
-                                                    opt_state, inputs, sub)
+                params, aux, opt_state, loss, _ok = body(
+                    params, aux, opt_state, inputs, sub)
                 return (params, aux, opt_state, key), loss
             (params, aux, opt_state, _), losses = lax.scan(
                 scan_body, (params, aux, opt_state, key), None,
                 length=n_steps, unroll=unroll)
-            return params, aux, opt_state, losses
+            if guard:
+                # window-level verdict: non-finite anywhere in the
+                # losses or the final params means some step of this
+                # window went bad (NaN in params persists once it
+                # appears, so the post-window check cannot miss it)
+                ok = jnp.all(jnp.stack(
+                    [jnp.isfinite(losses).all()]
+                    + [jnp.isfinite(p).all()
+                       for p in jax.tree.leaves(params)]))
+            else:
+                ok = jnp.bool_(True)
+            return params, aux, opt_state, losses, ok
 
         param_sh, aux_sh, opt_sh, in_sh, rep = self._shardings()
         self._step_many_fn = jax.jit(
             many,
             in_shardings=(param_sh, aux_sh, opt_sh, in_sh, None),
-            out_shardings=(param_sh, aux_sh, opt_sh, rep),
+            out_shardings=(param_sh, aux_sh, opt_sh, rep, rep),
             donate_argnums=(0, 1, 2), static_argnums=(5, 6))
 
     def step_many(self, *batch_and_labels, n_steps, unroll=1):
@@ -432,9 +484,17 @@ class ShardedTrainer:
         key = _random.next_key() if self._needs_rng else None
         from .mesh import use_mesh
         with use_mesh(self._mesh):
-            self._params, self._aux, self._opt_state, losses = \
-                self._step_many_fn(self._params, self._aux, self._opt_state,
-                                   inputs, key, int(n_steps), int(unroll))
+            (self._params, self._aux, self._opt_state, losses,
+             ok) = self._step_many_fn(
+                self._params, self._aux, self._opt_state,
+                inputs, key, int(n_steps), int(unroll))
+        if _num.enabled():
+            # one scalar verdict for the whole fused window — recorded
+            # as where="window": DETECTION-only (the scan body is
+            # unguarded, a bad window's weights WERE poisoned), so the
+            # collector counts it as an anomaly but never as a
+            # preserved/skipped step and never as SDC-replay-sound
+            _num.record_flag(ok, where="window")
         self._step_count += int(n_steps)
         return NDArray(losses)
 
@@ -575,6 +635,8 @@ class ShardedTrainer:
                           self._gc_residuals),
              rep_tree(self._aux)))
 
+        guard = _num.enabled()
+
         def step(params, aux, opt_state, residuals, inputs, key):
             loss, grads, new_res, auxup = smapped(params, aux, inputs,
                                                   residuals, key)
@@ -582,7 +644,21 @@ class ShardedTrainer:
                                                **hp)
             new_aux = dict(aux)
             new_aux.update(auxup or {})
-            return new_params, new_aux, new_state, new_res, loss
+            if guard:
+                # numerics guard over the RECONSTRUCTED (dequantized)
+                # gradients: a poisoned step passes params/aux/opt
+                # state AND the error-feedback residuals through
+                # bit-identical (a NaN residual would otherwise poison
+                # every later compressed exchange)
+                ok = _grads_finite(grads)
+                keep = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+                new_params = jax.tree.map(keep, new_params, params)
+                new_state = jax.tree.map(keep, new_state, opt_state)
+                new_aux = jax.tree.map(keep, new_aux, dict(aux))
+                new_res = jax.tree.map(keep, new_res, residuals)
+            else:
+                ok = jnp.bool_(True)
+            return new_params, new_aux, new_state, new_res, loss, ok
 
         rep = replicated(self._mesh)
         param_sh = {n: rep for n in self._params}
@@ -595,7 +671,7 @@ class ShardedTrainer:
         self._step_fn = jax.jit(
             step,
             in_shardings=(param_sh, aux_sh, opt_sh, res_sh, in_sh, None),
-            out_shardings=(param_sh, aux_sh, opt_sh, res_sh, rep),
+            out_shardings=(param_sh, aux_sh, opt_sh, res_sh, rep, rep),
             donate_argnums=(0, 1, 2, 3))
 
     def step(self, *batch_and_labels):
@@ -627,13 +703,15 @@ class ShardedTrainer:
         with use_mesh(self._mesh):
             if self._grad_compression is not None:
                 (self._params, self._aux, self._opt_state,
-                 self._gc_residuals, loss) = self._step_fn(
+                 self._gc_residuals, loss, ok) = self._step_fn(
                     self._params, self._aux, self._opt_state,
                     self._gc_residuals, inputs, key)
             else:
                 (self._params, self._aux, self._opt_state,
-                 loss) = self._step_fn(
+                 loss, ok) = self._step_fn(
                     self._params, self._aux, self._opt_state, inputs, key)
+        if _num.enabled():
+            _num.record_flag(ok, where="step")
         self._step_count += 1
         return NDArray(loss)
 

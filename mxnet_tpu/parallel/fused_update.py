@@ -53,6 +53,8 @@ from ..ndarray import NDArray
 from ..observability import registry as _obs
 from .. import optimizer as opt
 from ..optimizer import _prep, _UPDATE_DISPATCHES
+from ..resilience import numerics as _num
+from ..resilience.chaos import corrupt_point
 from .bucketing import GradBucketer
 
 __all__ = ["FusedUpdater", "fused_enabled", "donate_enabled",
@@ -165,15 +167,45 @@ _SUPPORTED = {
 _JITS = {}
 
 
-def _jit_for(spec, donate):
+def _guard_wrap(fn):
+    """Numerics-guarded kernel (ISSUE 10): the packed gradient flat
+    gets ONE fused isfinite-all reduce, and the update runs under a
+    ``lax.cond`` whose false branch passes the weight AND every state
+    flat through untouched — a poisoned group's step is skipped
+    in-graph, pre-step bits preserved exactly, no host round-trip in
+    the decision. `ok` rides out as a third result for the guard's
+    (deferred) host accounting.
+
+    ``lax.cond`` rather than ``jnp.where`` on purpose: the branch
+    compiles as its OWN XLA computation, so the update math keeps the
+    exact codegen (same fusion/FMA choices) of the standalone per-key
+    kernel — `jnp.where` merges the select into the update program and
+    XLA's different fusion decisions break the bit-parity contract
+    (observed on centered RMSProp)."""
+    def guarded(w, g, states, lr, t, wd, hyper):
+        ok = jnp.isfinite(g).all()
+        new_w, new_states = jax.lax.cond(
+            ok,
+            lambda: fn(w, g, states, lr, t, wd, hyper),
+            lambda: (w, tuple(states)))
+        return new_w, new_states, ok
+    return guarded
+
+
+def _jit_for(spec, donate, guarded=None):
     """The jitted fused kernel for one optimizer class. jax.jit's own
     cache handles per-(shape, static-hyper) specialization; donation
-    covers the weight flat (0) and every state flat (2)."""
-    key = (spec.name, bool(donate))
+    covers the weight flat (0) and every state flat (2). `guarded`
+    selects the numerics-guard wrapper (default: MXTPU_NUMERICS,
+    re-read per call)."""
+    if guarded is None:
+        guarded = _num.enabled()
+    key = (spec.name, bool(donate), bool(guarded))
     fn = _JITS.get(key)
     if fn is None:
+        body = _guard_wrap(spec.fn) if guarded else spec.fn
         fn = _JITS[key] = jax.jit(
-            spec.fn, static_argnums=(5, 6),
+            body, static_argnums=(5, 6),
             donate_argnums=(0, 2) if donate else ())
     return fn
 
@@ -304,6 +336,11 @@ class FusedUpdater(opt.Updater):
             super().update_all(indices, grads, weights)
             return
         entries, leftovers = self._collect(spec, indices, grads, weights)
+        if leftovers and _num.enabled():
+            # per-key leftover lanes update WITHOUT the in-graph guard:
+            # they veto full_skip so a partially-unguarded step can
+            # never claim the SDC replay's pre-step-state soundness
+            _num.note_unguarded(len(leftovers))
         # update counts for fused entries already happened in _collect;
         # they must NOT be rerouted through per-key __call__ (update()
         # would bump the count again). A 1-entry group still runs the
@@ -350,14 +387,30 @@ class FusedUpdater(opt.Updater):
             # (bit-identical to the per-key per-param casts — astype is
             # elementwise, so it commutes with concatenation)
             g_flat = g_flat.astype(w_flat.dtype)
+        # chaos corruption site on the packed gradient flat: kind=nan
+        # must be visible to the in-jit isfinite guard below, kind=raise
+        # behaves like a plain chaos_point (free when disarmed)
+        g_flat = corrupt_point("grad.post", g_flat)
         state_flats = tuple(
             bucket.pack([e.state_leaves[s]._data for e in group])
             for s in range(n_states))
         FUSED_PACK_SECONDS.observe(time.perf_counter() - t0)
         lr, wd = group[0].lr, group[0].wd
         t0 = time.perf_counter()
-        new_w, new_states = _jit_for(spec, donate)(
+        guarded = _num.enabled()
+        out = _jit_for(spec, donate, guarded)(
             w_flat, g_flat, state_flats, lr, t, wd, spec.hyper(o))
+        if guarded:
+            new_w, new_states, ok = out
+            # device scalar only — resolved at the guard's next step
+            # boundary, so the skip itself costs no host round-trip
+            _num.record_flag(ok, keys=bucket.keys, where="update")
+        else:
+            new_w, new_states = out
+        # post-update corruption site: a bitflip HERE lands in the
+        # written weights past the guard — the silent-data-corruption
+        # scenario only divergence/rollback machinery can catch
+        new_w = corrupt_point("weight.post", new_w)
         FUSED_GROUPS.inc()
         _UPDATE_DISPATCHES.inc()
         FUSED_UPDATE_SECONDS.observe(time.perf_counter() - t0)

@@ -29,13 +29,15 @@ from ..kvstore import (KVStore, _key_value, _nbytes, _priority_order,
                        _PUSH_SECONDS)
 from ..observability import registry as _obs
 from ..resilience import lease as _lease
+from ..resilience import numerics as _num
 from ..resilience import supervisor as _sup
 from ..resilience.chaos import chaos_point, InjectedFailure
 from ..resilience.retry import (DeadlineExceeded, RetryPolicy,
                                 TransientError, retry_call)
 from ..resilience.watchdog import HealthWatchdog
 from .bucketing import (GradBucketer, BUCKET_COUNT, BUCKET_KEYS,
-                        BUCKET_FILL, PACK_SECONDS, UNPACK_SECONDS)
+                        BUCKET_FILL, PACK_SECONDS, UNPACK_SECONDS,
+                        finite_all)
 
 __all__ = ["DistKVStore", "init_distributed"]
 
@@ -326,7 +328,16 @@ class DistKVStore(KVStore):
             out = retry_call(self._issue_bucket, bucket, merged,
                              policy=policy)
             issued.append((bucket, out))
+        guard = _num.enabled()
         for bucket, out in issued:
+            if guard:
+                # numerics guard (ISSUE 10): one isfinite-all reduce
+                # piggybacked per fusion bucket on the reduced flat —
+                # a device scalar, no host sync here; the guard drains
+                # it at the step boundary to attribute anomalies to
+                # the exchange (vs the local update path)
+                _num.record_flag(finite_all(out), keys=bucket.keys,
+                                 where="exchange")
             t0 = time.perf_counter()
             for k, sub in zip(bucket.keys, bucket.unpack(out)):
                 self._apply_merged(k, sub)

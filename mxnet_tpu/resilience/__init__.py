@@ -35,6 +35,13 @@ missing machinery, wired through the runtime at named sites:
              checkpoint with bounded restarts; `RankHeartbeat` +
              `PeerLost` give survivors seconds-level dead-peer
              detection instead of a full watchdog timeout.
+- `numerics`: training numerics guard (ISSUE 10) — in-graph NaN/Inf
+             detection with skip-and-preserve in the fused update and
+             the ShardedTrainer step, `GradScaler` dynamic loss
+             scaling, `DivergenceWatchdog` + rollback to the last
+             committed checkpoint (`TrainingDiverged`, exit 77), and
+             SDC replay classification (hardware bit-flip vs
+             data/optimization).
 - `metrics`: process-wide counters (injected faults, skipped corrupt
              records) surfaced for monitoring.
 """
@@ -47,9 +54,12 @@ from .preempt import (PreemptionGuard, TrainingPreempted,
 from .atomic import atomic_write, exclusive_create
 from .lease import DeviceLease, LeaseHeld
 from .watchdog import DeviceUnreachable, HealthWatchdog
+from .numerics import (NumericsGuard, GradScaler, DivergenceWatchdog,
+                       TrainingDiverged, EXIT_DIVERGED)
 from .supervisor import (GangSupervisor, PeerLost, RankHeartbeat,
                          run_supervised, EXIT_PREEMPTED, EXIT_PEER_LOST)
 from . import metrics
+from . import numerics
 from .metrics import counters
 
 __all__ = ["RetryPolicy", "retry", "retry_call", "Deadline",
@@ -61,4 +71,6 @@ __all__ = ["RetryPolicy", "retry", "retry_call", "Deadline",
            "DeviceLease", "LeaseHeld", "DeviceUnreachable",
            "HealthWatchdog", "GangSupervisor", "PeerLost",
            "RankHeartbeat", "run_supervised", "EXIT_PREEMPTED",
-           "EXIT_PEER_LOST", "metrics", "counters"]
+           "EXIT_PEER_LOST", "EXIT_DIVERGED", "NumericsGuard",
+           "GradScaler", "DivergenceWatchdog", "TrainingDiverged",
+           "metrics", "numerics", "counters"]

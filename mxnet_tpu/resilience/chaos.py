@@ -13,7 +13,11 @@ Fields per site:
          sleep  -> time.sleep(secs) (exercises deadlines)
          kill   -> SIGKILL this process (the rank-death chaos mode —
                    no cleanup, no atexit: exactly what a preempted VM
-                   or an OOM kill looks like to the gang) (default raise)
+                   or an OOM kill looks like to the gang)
+         nan    -> poison one seeded element of the array at a
+                   corrupt_point (numerics-guard skip proof)
+         bitflip-> flip one seeded bit at a corrupt_point (the silent
+                   data corruption simulation)       (default raise)
   secs   sleep duration for kind=sleep                 (default 0.1)
   n      stop tripping after n faults                  (default unlimited)
   after  skip the first `after` draws                  (default 0)
@@ -39,10 +43,14 @@ kills a rank mid-run), `engine.host_push`, `serving.infer`,
 `serving.decode` (fires before every continuous-batching decode step;
 kind=sleep stretches steps so deadline eviction can be exercised,
 kind=raise fails every in-flight sequence), `lease.acquire` (before a
-`DeviceLease.acquire` touches the lease file), and `device.init`
+`DeviceLease.acquire` touches the lease file), `device.init`
 (before `HealthWatchdog.init_devices` probes the backend — kind=sleep
-exercises the init deadline). A `chaos_point(site)` call is free when
-no spec is configured (one dict lookup).
+exercises the init deadline), and the array-corruption sites
+`grad.post` / `weight.post` (`corrupt_point` in the fused update:
+kind=nan / kind=bitflip mutate the packed flats — the numerics-guard
+proof sites, docs/fault_tolerance.md "Training numerics guard"). A
+`chaos_point(site)` call is free when no spec is configured (one dict
+lookup).
 """
 from __future__ import annotations
 
@@ -57,7 +65,7 @@ from .retry import TransientError
 from . import metrics
 
 __all__ = ["InjectedFault", "InjectedFailure", "parse_spec", "configure",
-           "reset", "chaos_point", "trip_count"]
+           "reset", "chaos_point", "corrupt_point", "trip_count"]
 
 
 class InjectedFault(TransientError):
@@ -71,9 +79,17 @@ class InjectedFailure(MXNetError):
 
 
 _FIELDS = {"p": float, "secs": float, "n": int, "after": int, "kind": str}
-_KINDS = ("raise", "fatal", "sleep", "kill")
+_KINDS = ("raise", "fatal", "sleep", "kill", "nan", "bitflip")
+# kinds that mutate an ARRAY at a corrupt_point instead of raising at a
+# chaos_point: kind=nan poisons one element (caught by the numerics
+# guard's in-graph isfinite check -> the skip path), kind=bitflip flips
+# one seeded bit (the silent-data-corruption simulation: usually a
+# finite-but-wrong value the isfinite check can NOT see, so only the
+# divergence watchdog / SDC replay catch it)
+_CORRUPT_KINDS = ("nan", "bitflip")
 
-_KILL = object()   # decide() verdict sentinel for kind=kill
+_KILL = object()      # decide() verdict sentinel for kind=kill
+_CORRUPT = object()   # decide() verdict sentinel for corrupt kinds
 
 
 def parse_spec(spec):
@@ -139,6 +155,8 @@ class _Site:
             return self.secs
         if self.kind == "kill":
             return _KILL
+        if self.kind in _CORRUPT_KINDS:
+            return _CORRUPT
         cls = InjectedFailure if self.kind == "fatal" else InjectedFault
         return cls("[chaos] injected %s fault at %r (trip %d, draw %d, "
                    "spec site %r)" % (self.kind, at_site, self.trips,
@@ -218,6 +236,10 @@ def chaos_point(site):
     sp = _lookup(site)
     if sp is None:
         return
+    if sp.kind in _CORRUPT_KINDS:
+        # corrupt kinds only fire at corrupt_point (they need an array
+        # to mutate); a plain chaos_point must not burn their draws
+        return
     with _lock:
         verdict = sp.decide(site)
     if verdict is None:
@@ -231,6 +253,61 @@ def chaos_point(site):
         time.sleep(verdict)
         return
     raise verdict
+
+
+def corrupt_point(site, array):
+    """Declare a named ARRAY-corruption site (`grad.post` fires on each
+    packed gradient flat entering the fused update, `weight.post` on
+    each updated weight flat leaving it). Returns `array` unchanged —
+    one dict lookup — unless the site is armed with a corrupt kind and
+    the seeded draw trips; then a corrupted copy is returned:
+
+    - ``kind=nan``: one seeded element set to NaN (the in-graph
+      isfinite guard catches it -> skip-and-preserve);
+    - ``kind=bitflip``: one seeded bit of one seeded element flipped
+      (the SDC simulation: typically finite-but-wrong, invisible to
+      isfinite — only divergence/replay machinery can catch it).
+
+    The corruption is deterministic (element and bit come from the
+    site's seeded RNG), so a chaos run replays bit-identically.
+    Non-corrupt kinds armed on a corrupt site behave like chaos_point
+    (raise/sleep/kill), so e.g. `grad.post:kind=fatal` still works."""
+    sp = _lookup(site)
+    if sp is None:
+        return array
+    if sp.kind not in _CORRUPT_KINDS:
+        chaos_point(site)
+        return array
+    with _lock:
+        verdict = sp.decide(site)
+        if verdict is None:
+            return array
+        # draws under the lock so concurrent corrupt points stay
+        # deterministic: element/bit picks are part of the site stream
+        pick = sp.rng.random()
+        bitpick = sp.rng.random()
+    import numpy as _np
+    host = _np.array(array, copy=True)
+    flat = host.reshape(-1)
+    idx = min(int(pick * flat.size), flat.size - 1) if flat.size else 0
+    if flat.size == 0:
+        return array
+    if sp.kind == "nan":
+        if _np.issubdtype(flat.dtype, _np.floating):
+            flat[idx] = _np.nan
+        else:   # integer buffers have no NaN: max value is the poison
+            flat[idx] = _np.iinfo(flat.dtype).max
+    else:   # bitflip
+        view = flat.view(_np.uint8)
+        nbits = 8 * flat.dtype.itemsize
+        bit = min(int(bitpick * nbits), nbits - 1)
+        byte = idx * flat.dtype.itemsize + bit // 8
+        view[byte] ^= _np.uint8(1 << (bit % 8))
+    try:
+        import jax.numpy as _jnp
+        return _jnp.asarray(host)
+    except ImportError:       # host-array caller (tests)
+        return host
 
 
 def trip_count(site):

@@ -43,6 +43,10 @@ Exit-code contract (restart-vs-stop without parsing stderr):
                         going away; a relaunch is futile here)
   peer lost         76  survivor of a gang failure: expected
                         collateral, never the root cause
+  diverged          77  numerics rollback already performed by
+                        the worker (suspect checkpoints
+                        dropped): restart-with-rollback — the
+                        relaunch resumes from trusted state
   anything else    any  crash: teardown + restart (bounded)
   ==============  ====  =====================================
 
@@ -78,15 +82,21 @@ from ..observability import telemetry as _tele
 from .atomic import atomic_write
 from .lease import (_boot_id, _heartbeat_age, _holder_alive,
                     _proc_starttime)
+from .numerics import TrainingDiverged, EXIT_DIVERGED
 from .preempt import TrainingPreempted
 
 __all__ = ["PeerLost", "RankHeartbeat", "GangSupervisor", "gang_dir",
            "ensure_rank_heartbeat", "read_heartbeat", "peer_status",
            "dead_peers", "peer_checker", "run_supervised",
-           "exit_status", "EXIT_PREEMPTED", "EXIT_PEER_LOST"]
+           "exit_status", "EXIT_PREEMPTED", "EXIT_PEER_LOST",
+           "EXIT_DIVERGED"]
 
 EXIT_PREEMPTED = TrainingPreempted.exit_code   # 75 (preempt.py)
 EXIT_PEER_LOST = 76
+# EXIT_DIVERGED (77) comes from numerics.py: the worker already rolled
+# back (suspect committed checkpoints dropped) before exiting, so the
+# supervisor's relaunch resumes from trusted state — restart, never a
+# crash loop on the same diverged checkpoint
 
 RESTARTS = _obs.counter(
     "resilience.supervisor.restarts",
@@ -411,6 +421,14 @@ def run_supervised(fn):
     except TrainingPreempted as err:
         print("run_supervised: %s" % err, file=sys.stderr, flush=True)
         sys.exit(exit_status(err))
+    except TrainingDiverged as err:
+        # the numerics guard already rolled back (dropped the suspect
+        # committed steps and restored the trusted one); exit 77 asks
+        # for a plain relaunch — the recovered gang resumes from the
+        # rolled-back step. A clean sys.exit is safe here: divergence
+        # is detected at a step boundary, not inside a dead collective
+        print("run_supervised: %s" % err, file=sys.stderr, flush=True)
+        sys.exit(exit_status(err))
     except PeerLost as err:
         print("run_supervised: %s" % err, file=sys.stderr, flush=True)
         sys.stdout.flush()
@@ -672,6 +690,12 @@ class GangSupervisor:
             incident = {"generation": self.generation, "rank": rank,
                         "exit_code": rc, "rank_exit_codes": rcs,
                         "wedged": wedged, "ts": time.time()}
+            if observed_rc == EXIT_DIVERGED:
+                # numerics rollback (ISSUE 10): the worker dropped its
+                # suspect committed checkpoints before exiting, so the
+                # relaunch resumes from the rolled-back step — a
+                # restart that makes progress, not a crash loop
+                incident["diverged"] = True
             # restart-vs-stop is decided by the ROOT CAUSE alone: in a
             # real platform preemption every rank gets the SIGTERM and
             # the first failure observed is an exit-75; when a rank
@@ -714,7 +738,9 @@ class GangSupervisor:
             self.spawn()
             downtime = time.monotonic() - t_detect
             DOWNTIME.observe(downtime)
-            incident["action"] = "restart"
+            incident["action"] = ("restart (rolled back)"
+                                  if incident.get("diverged")
+                                  else "restart")
             incident["downtime_s"] = round(downtime, 3)
             incident["backoff_s"] = backoff
             self.incidents.append(incident)

@@ -1,0 +1,125 @@
+"""Supervised training worker for the numerics-guard restart tests.
+
+A single-rank deterministic training loop through the REAL fused-update
+path (`optimizer.get_updater` -> `parallel.FusedUpdater`, so the
+`grad.post` chaos corruption site and the in-graph isfinite skip both
+apply), checkpointing every step through `TrainerCheckpoint`'s
+committed manifests, with a `NumericsGuard` wired for divergence
+rollback. Under `tools/launch.py --supervise -n 1` with a
+`MXTPU_CHAOS_RANK_0="grad.post:kind=bitflip,..."` injection the chain
+to prove is (ISSUE 10 acceptance):
+
+    bitflip at step K -> in-graph skip (non-finite grads preserved
+    pre-step weights bit-identically) and/or loss spike -> divergence
+    watchdog -> rollback (suspect committed steps dropped, last trusted
+    restored) -> TrainingDiverged exit 77 -> supervisor relaunch (chaos
+    stripped from generation 1) -> resume -> final params BIT-IDENTICAL
+    to an uninterrupted run.
+
+Gradients are a pure function of (step, params): grad_i = 0.1*w_i +
+0.01*noise(step), so replaying rolled-back steps from a bit-identical
+restored state reproduces the reference trajectory bit-for-bit — the
+`tests/test_gang_restart.py` oracle applied to numerics.
+
+Events land in `<out>.r0.jsonl`:
+  {"event": "start", "restored_step": ..., "generation": ...}
+  {"event": "done", "step": ..., "params_hex": <float32 bytes>}
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    from mxnet_tpu.resilience import (at_step_boundary, numerics,
+                                      run_supervised)
+
+    out_path = "%s.r0.jsonl" % args.out
+
+    def emit(rec):
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+
+    class _State:
+        """TrainerCheckpoint state contract with host (numpy) truth —
+        replicated-and-serializable, exactly the gang_worker shape."""
+
+        def __init__(self):
+            self._params = {
+                "w0": np.full((args.dim,), 1.5, "float32"),
+                "w1": np.full((args.dim,), -0.8, "float32")}
+            self._aux = {}
+            self._opt_state = {}
+            self._step_count = 0
+
+    st = _State()
+    ck = TrainerCheckpoint(args.ckpt_dir, max_to_keep=None)
+    restored = ck.restore_latest(st)
+    emit({"event": "start", "restored_step": restored,
+          "generation": int(os.environ.get("MXTPU_GANG_GENERATION",
+                                           -1))})
+
+    guard = numerics.NumericsGuard(source="numerics_worker")
+    guard.attach_rollback(ck, st)
+    # momentum-less SGD: no optimizer state to round-trip, and the two
+    # same-lane params still fuse into ONE group -> one grad.post draw
+    # per step, which makes the chaos spec's `after=K` count steps
+    updater = opt.get_updater(opt.create("sgd", learning_rate=0.05))
+
+    def body():
+        for step in range(st._step_count + 1, args.steps + 1):
+            at_step_boundary()
+            rng = np.random.RandomState(9991 * step)
+            ws = [mx.nd.array(st._params["w0"]),
+                  mx.nd.array(st._params["w1"])]
+            gs = []
+            noise = rng.randn(2, args.dim).astype("float32")
+            for i, k in enumerate(("w0", "w1")):
+                gs.append(mx.nd.array(
+                    (np.float32(0.1) * st._params[k]
+                     + np.float32(0.01) * noise[i]).astype("float32")))
+            updater.update_all([0, 1], gs, ws)
+            st._params = {"w0": np.asarray(ws[0]._data),
+                          "w1": np.asarray(ws[1]._data)}
+            st._step_count = step
+            # float32 loss on purpose: corrupted (huge) weights must
+            # overflow to inf so the watchdog sees a non-finite value
+            loss = float(np.sum(np.square(st._params["w0"]),
+                                dtype=np.float32)
+                         + np.sum(np.square(st._params["w1"]),
+                                  dtype=np.float32))
+            ck.save(step, st, wait=True)
+            # boundary AFTER the save: a diverged verdict must be able
+            # to drop the step just saved (it captured suspect weights)
+            guard.step_boundary(step=step, loss=loss)
+        emit({"event": "done", "step": st._step_count,
+              "params_hex": (np.asarray(st._params["w0"], "float32")
+                             .tobytes()
+                             + np.asarray(st._params["w1"], "float32")
+                             .tobytes()).hex()})
+        print("NUMERICS_WORKER_DONE", flush=True)
+
+    run_supervised(body)
+
+
+if __name__ == "__main__":
+    main()

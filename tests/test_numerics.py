@@ -1,0 +1,719 @@
+"""Training numerics guard (resilience/numerics.py, ISSUE 10).
+
+1. in-graph detection + skip: a NaN in one fused group's packed
+   gradients leaves that group's weights AND optimizer state
+   bit-identical to pre-step while other lanes update (per-lane
+   isolation); MXTPU_NUMERICS=0 restores the poison-through behavior.
+2. dynamic loss scaling: GradScaler halve-on-overflow /
+   grow-after-window schedule, armed only by scale_loss.
+3. divergence watchdog + rollback: spike detection vs the rolling
+   median, last-trusted-step arithmetic, committed-checkpoint rollback
+   + typed TrainingDiverged (exit 77).
+4. SDC replay classification: a bit-identical replay is
+   data/optimization, a bit-differing one is suspected hardware SDC.
+5. satellites: fused clip_global_norm bit parity, chaos nan/bitflip
+   corruption kinds, telemetry/perf-gate skipped-step budgets,
+   chaos_run --nan-at-step, and the slow bitflip -> skip/spike ->
+   rollback -> resume bit-identical oracle.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience import numerics as num
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard_state(monkeypatch):
+    num.reset_flags()
+    chaos.reset()
+    yield
+    num.reset_flags()
+    chaos.reset()
+
+
+def _two_lane_updater():
+    """Two params in two distinct fused lanes (lr_mult split), one
+    group each."""
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    o.lr_mult = {0: 1.0, 1: 0.5}
+    return opt.get_updater(o)
+
+
+def _params(seed=0, n=2, dim=8):
+    rng = np.random.RandomState(seed)
+    return [mx.nd.array(rng.randn(dim).astype("float32"))
+            for _ in range(n)]
+
+
+# -- in-graph skip -------------------------------------------------------
+def test_nan_group_skipped_bit_identical_other_lane_updates():
+    ws, gs = _params(0), _params(1)
+    upd = _two_lane_updater()
+    upd.update_all([0, 1], gs, ws)
+    num.reset_flags()
+    w_before = [w.asnumpy().copy() for w in ws]
+    s_before = {i: np.asarray(upd.states[i]._data).copy() for i in (0, 1)}
+    gs[0]._data = gs[0]._data.at[3].set(float("nan"))
+    upd.update_all([0, 1], gs, ws)
+    r = num.drain_flags()
+    assert r["bad"] == 1 and r["total"] == 2
+    assert r["skipped_steps"] == 1 and r["bad_keys"] == [0]
+    assert not r["full_skip"]
+    # poisoned lane: weights AND momentum bit-identical to pre-step
+    assert np.array_equal(ws[0].asnumpy(), w_before[0])
+    assert np.array_equal(np.asarray(upd.states[0]._data), s_before[0])
+    # clean lane still updated
+    assert not np.array_equal(ws[1].asnumpy(), w_before[1])
+    assert not np.array_equal(np.asarray(upd.states[1]._data),
+                              s_before[1])
+
+
+def test_guard_off_restores_poison_through(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "0")
+    ws, gs = _params(0), _params(1)
+    upd = _two_lane_updater()
+    gs[0]._data = gs[0]._data.at[0].set(float("nan"))
+    upd.update_all([0, 1], gs, ws)
+    assert num.pending_flags() == 0     # no flags recorded when off
+    assert np.isnan(ws[0].asnumpy()).any()   # today's behavior
+
+
+def test_clean_path_bit_parity_with_guard_off(monkeypatch):
+    """where(True, new, old) is a bitwise identity: guarded and
+    unguarded updates agree bit-for-bit on finite gradients."""
+    results = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("MXTPU_NUMERICS", flag)
+        ws, gs = _params(3), _params(4)
+        upd = _two_lane_updater()
+        for _ in range(3):
+            upd.update_all([0, 1], gs, ws)
+        results[flag] = [w.asnumpy().copy() for w in ws]
+    for a, b in zip(results["1"], results["0"]):
+        assert np.array_equal(a, b)
+    num.reset_flags()
+
+
+def test_sharded_trainer_in_graph_skip():
+    import jax
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype("float32")
+    net(mx.nd.array(x))
+    loss = gluon.loss.L2Loss()
+    st = ShardedTrainer(net, lambda o_, l: loss(o_, l), "sgd",
+                        {"learning_rate": 0.05},
+                        mesh=make_mesh({"dp": 1}))
+    y = np.zeros((8, 4), "float32")
+    st.step(mx.nd.array(x), mx.nd.array(y))
+    num.reset_flags()
+    before = {k: np.asarray(v) for k, v in st.params.items()}
+    xb = x.copy()
+    xb[0, 0] = np.nan
+    st.step(mx.nd.array(xb), mx.nd.array(y))
+    r = num.drain_flags()
+    assert r["bad"] == 1 and r["full_skip"]
+    for k, v in st.params.items():
+        assert np.array_equal(np.asarray(v), before[k]), k
+    # clean step afterwards updates again
+    st.step(mx.nd.array(x), mx.nd.array(y))
+    assert num.drain_flags()["bad"] == 0
+    changed = any(not np.array_equal(np.asarray(v), before[k])
+                  for k, v in st.params.items())
+    assert changed
+
+
+def test_gluon_trainer_step_skips_and_counts():
+    from mxnet_tpu import autograd, gluon
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 5)
+                    .astype("float32"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    y = mx.nd.zeros((4, 3))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(4)
+    before = {p.name: p.data().asnumpy().copy()
+              for p in net.collect_params().values()}
+    skipped0 = num.SKIPPED.total()
+    for p in net.collect_params().values():
+        g = p.grad()
+        g._data = (g._data.at[0].set(float("nan")) if g._data.ndim == 1
+                   else g._data.at[0, 0].set(float("nan")))
+    tr.step(4)
+    assert num.SKIPPED.total() == skipped0 + 1
+    for p in net.collect_params().values():
+        assert np.array_equal(p.data().asnumpy(), before[p.name])
+
+
+# -- loss scaling --------------------------------------------------------
+def test_grad_scaler_schedule():
+    s = num.GradScaler(init_scale=1024.0, growth_interval=3,
+                       min_scale=1.0, max_scale=4096.0)
+    # disarmed: identity
+    assert s.scale == 1.0
+    assert s.update(True) == 1.0 and s.scale == 1.0
+    # armed by scale_loss
+    assert s.scale_loss(2.0) == 2048.0
+    assert s.scale == 1024.0
+    s.update(True)
+    assert s.scale == 512.0           # halve on overflow
+    s.update(False)
+    s.update(False)
+    assert s.scale == 512.0           # window not reached
+    s.update(False)
+    assert s.scale == 1024.0          # grew after 3 clean steps
+    s.update(True)
+    assert s.good_steps == 0          # overflow resets the window
+    for _ in range(40):
+        s.update(True)
+    assert s.scale == 1.0             # clamped at min
+    for _ in range(100):
+        s.update(False)
+    assert s.scale <= 4096.0          # clamped at max
+
+
+def test_trainer_scale_loss_folds_unscale_into_rescale_grad():
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net(mx.nd.zeros((2, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    assert tr.loss_scale == 1.0
+    assert tr._rescale(2) == 0.5      # unarmed: plain 1/batch
+    scaled = tr.scale_loss(mx.nd.ones((2,)))
+    assert float(scaled.asnumpy()[0]) == tr.loss_scale
+    assert tr.loss_scale > 1.0
+    assert tr._rescale(2) == pytest.approx(0.5 / tr.loss_scale)
+
+
+# -- divergence watchdog + rollback -------------------------------------
+def test_watchdog_spike_and_last_good():
+    wd = num.DivergenceWatchdog(patience=3, factor=10.0, window=16,
+                                min_history=3)
+    for step in range(8):
+        assert not wd.observe(step, 1.0 + 0.01 * step)
+    assert not wd.observe(8, 5.0)     # 5x: under the factor
+    assert wd.bad_streak == 0
+    assert not wd.observe(9, 1e5)
+    assert wd.bad_streak == 1 and wd.first_bad_step == 9
+    assert not wd.observe(10, float("nan"))
+    assert wd.observe(11, 1e5)        # patience 3 reached
+    assert wd.last_good_step() == 7   # first_bad - 2
+    # a good value resets the streak
+    wd2 = num.DivergenceWatchdog(patience=2, factor=10.0, window=8,
+                                 min_history=2)
+    wd2.observe(0, 1.0)
+    wd2.observe(1, 1.0)
+    wd2.observe(2, 1e5)
+    assert not wd2.observe(3, 1.0)
+    assert wd2.bad_streak == 0 and wd2.first_bad_step is None
+
+
+def _ckpt_state(dim=4):
+    class _State:
+        def __init__(self):
+            self._params = {"w": np.zeros(dim, "float32")}
+            self._aux = {}
+            self._opt_state = {}
+            self._step_count = 0
+    return _State()
+
+
+def test_rollback_drops_suspect_steps_and_restores(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    st = _ckpt_state()
+    ck = TrainerCheckpoint(str(tmp_path / "ck"))
+    for step in range(1, 7):
+        st._params["w"] = st._params["w"] + np.float32(1.0)
+        st._step_count = step
+        ck.save(step, st, wait=True)
+    rollbacks0 = num.ROLLBACKS.total()
+    guard = num.NumericsGuard(
+        source="t",
+        watchdog=num.DivergenceWatchdog(patience=2, min_history=2))
+    guard.attach_rollback(ck, st)
+    for step in range(5):
+        guard.step_boundary(step=step, loss=1.0)
+    guard.step_boundary(step=5, loss=1e9)
+    with pytest.raises(num.TrainingDiverged) as ei:
+        guard.step_boundary(step=6, loss=1e9)
+    err = ei.value
+    assert err.exit_code == 77 and num.EXIT_DIVERGED == 77
+    assert err.first_bad_step == 5
+    # first bad observation at 5 indicts checkpoint 4: trusted == 3
+    assert err.restored_step == 3
+    assert st._step_count == 3
+    assert float(st._params["w"][0]) == 3.0
+    assert sorted(ck.all_steps()) == [1, 2, 3]
+    assert num.ROLLBACKS.total() == rollbacks0 + 1
+
+
+def test_drop_steps_after(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import TrainerCheckpoint
+    st = _ckpt_state()
+    ck = TrainerCheckpoint(str(tmp_path / "ck"))
+    for step in (1, 2, 3, 4):
+        st._step_count = step
+        ck.save(step, st, wait=True)
+    assert ck.drop_steps_after(2) == [3, 4]
+    assert sorted(ck.all_steps()) == [1, 2]
+    assert ck.drop_steps_after(10) == []
+
+
+# -- SDC replay classification ------------------------------------------
+def _bad_flag():
+    import jax.numpy as jnp
+    return jnp.array(False)
+
+
+def test_sdc_replay_bit_identical_is_deterministic():
+    """Persistent anomalies (chaos kind=nan shape: the data itself is
+    bad) replay bit-identically -> data/optimization verdict."""
+    grads = [mx.nd.array(np.ones(4, "float32"))]
+    guard = num.NumericsGuard(source="t")
+    guard.attach_replay(lambda: grads)
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    rep = guard.step_boundary(step=0, grads=grads)
+    assert rep["sdc"] == "deterministic"
+    assert num.ANOMALIES.get(kind="deterministic") >= 1
+
+
+def test_sdc_replay_bit_differing_is_suspected_sdc():
+    sdc0 = num.SDC_SUSPECTED.total()
+    grads = [mx.nd.array(np.ones(4, "float32"))]
+    replayed = [mx.nd.array(np.ones(4, "float32") * 2)]
+    guard = num.NumericsGuard(source="t")
+    guard.attach_replay(lambda: replayed)
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    rep = guard.step_boundary(step=0, grads=grads)
+    assert rep["sdc"] == "sdc"
+    assert num.SDC_SUSPECTED.total() == sdc0 + 1
+    # only the FIRST anomaly replays
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    rep2 = guard.step_boundary(step=1, grads=grads)
+    assert rep2["sdc"] is None
+
+
+def test_exchange_only_bad_is_anomaly_not_skip():
+    """With the per-key fallback the exchange probe is the ONLY
+    signal: it must count as an anomaly but never claim the step was
+    skipped (the unguarded apply DID poison the weights)."""
+    num.record_flag(_bad_flag(), keys=[3], where="exchange")
+    r = num.drain_flags()
+    assert r["anomalies"] == 1 and r["exchange_bad"] == 1
+    assert r["skipped_steps"] == 0 and not r["full_skip"]
+
+
+def test_exchange_plus_update_bad_is_one_anomaly():
+    """Fused-on dist config: the exchange verdict is a second
+    observation of the SAME NaNs, not a second anomaly."""
+    num.record_flag(_bad_flag(), keys=[0], where="exchange")
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    r = num.drain_flags()
+    assert r["bad"] == 2 and r["anomalies"] == 1
+    assert r["skipped_steps"] == 1 and r["full_skip"]
+
+
+def test_window_bad_is_detection_only():
+    """step_many's window verdict: anomaly yes, skipped/replayable
+    no — the scanned body is unguarded and the weights were
+    poisoned."""
+    num.record_flag(_bad_flag(), where="window")
+    r = num.drain_flags()
+    assert r["anomalies"] == 1
+    assert r["skipped_steps"] == 0 and not r["full_skip"]
+
+
+def test_unguarded_leftovers_veto_full_skip():
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    num.note_unguarded(1)
+    r = num.drain_flags()
+    assert r["skipped_steps"] == 1
+    assert not r["full_skip"] and r["unguarded"] == 1
+
+
+def test_diverged_without_rollback_target_is_plain_crash():
+    """exit 77 is the supervisor's 'already rolled back' contract; a
+    guard with no checkpoint attached must surface divergence as an
+    ordinary crash (exit 1), not claim a rollback that never ran."""
+    guard = num.NumericsGuard(
+        source="t",
+        watchdog=num.DivergenceWatchdog(patience=1, min_history=1))
+    with pytest.raises(num.TrainingDiverged) as ei:
+        guard.step_boundary(step=0, loss=float("nan"))
+    assert ei.value.exit_code == 1
+    assert ei.value.restored_step is None
+
+
+def test_armed_scaler_overflow_is_calibration_not_divergence():
+    """Loss-scale warm-up (an armed scaler backing off) must not feed
+    the divergence watchdog — only a FLOORED scale makes skips count."""
+    scaler = num.GradScaler(init_scale=8.0, min_scale=1.0,
+                            growth_interval=1000)
+    scaler.scale_loss(1.0)   # arm
+    guard = num.NumericsGuard(
+        source="t", scaler=scaler,
+        watchdog=num.DivergenceWatchdog(patience=2, min_history=99))
+    # three overflow steps: scale 8 -> 4 -> 2 -> 1, never diverges
+    for step in range(3):
+        num.record_flag(_bad_flag(), keys=[0], where="update")
+        guard.step_boundary(step=step)
+    assert scaler.scale == 1.0
+    assert guard.watchdog.bad_streak == 0
+    # floored scale: skips are real anomalies again
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    guard.step_boundary(step=3)
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    with pytest.raises(num.TrainingDiverged):
+        guard.step_boundary(step=4)
+
+
+def test_sdc_replay_none_return_abstains():
+    grads = [mx.nd.array(np.ones(4, "float32"))]
+    guard = num.NumericsGuard(source="t")
+    guard.attach_replay(lambda: None)
+    num.record_flag(_bad_flag(), keys=[0], where="update")
+    rep = guard.step_boundary(step=0, grads=grads)
+    assert rep["sdc"] is None
+
+
+def test_sdc_replay_requires_full_skip():
+    """A partially-applied step (one clean lane updated) makes replay
+    unsound — the guard must not classify."""
+    grads = [mx.nd.array(np.ones(4, "float32"))]
+    guard = num.NumericsGuard(source="t")
+    guard.attach_replay(lambda: grads)
+    import jax.numpy as jnp
+    num.record_flag(jnp.array(False), keys=[0], where="update")
+    num.record_flag(jnp.array(True), keys=[1], where="update")
+    rep = guard.step_boundary(step=0, grads=grads)
+    assert rep["sdc"] is None
+
+
+def test_chaos_nan_at_fused_update_is_skipped_and_counted():
+    chaos.configure("grad.post:kind=nan,n=1", seed=5)
+    ws, gs = _params(0), _params(1)
+    o = opt.create("sgd", learning_rate=0.1)
+    upd = opt.get_updater(o)
+    w_before = [w.asnumpy().copy() for w in ws]
+    upd.update_all([0, 1], gs, ws)     # one lane -> ONE group
+    r = num.drain_flags()
+    assert chaos.trip_count("grad.post") == 1
+    assert r["bad"] == 1 and r["full_skip"]
+    for w, b in zip(ws, w_before):
+        assert np.array_equal(w.asnumpy(), b)
+    # n=1: next step clean, updates proceed
+    upd.update_all([0, 1], gs, ws)
+    assert num.drain_flags()["bad"] == 0
+    assert not np.array_equal(ws[0].asnumpy(), w_before[0])
+
+
+# -- chaos corruption kinds ---------------------------------------------
+def test_parse_spec_accepts_corrupt_kinds():
+    spec = chaos.parse_spec("grad.post:kind=nan,after=3;"
+                            "weight.post:kind=bitflip,n=1")
+    assert spec["grad.post"]["kind"] == "nan"
+    assert spec["weight.post"]["kind"] == "bitflip"
+    with pytest.raises(Exception):
+        chaos.parse_spec("grad.post:kind=frobnicate")
+
+
+def test_corrupt_point_deterministic_and_chaos_point_free():
+    import jax.numpy as jnp
+    a = jnp.ones(16, "float32")
+    chaos.configure("grad.post:kind=bitflip,n=1", seed=3)
+    # chaos_point on a corrupt-kind site must not burn the draw
+    chaos.chaos_point("grad.post")
+    c1 = np.asarray(chaos.corrupt_point("grad.post", a))
+    chaos.configure("grad.post:kind=bitflip,n=1", seed=3)
+    c2 = np.asarray(chaos.corrupt_point("grad.post", a))
+    assert np.array_equal(c1, c2)
+    assert (c1 != np.asarray(a)).sum() == 1      # exactly one element
+    # n=1 exhausted: identity afterwards
+    chaos.configure("grad.post:kind=nan,n=1", seed=3)
+    c3 = np.asarray(chaos.corrupt_point("grad.post", a))
+    assert np.isnan(c3).sum() == 1
+    c4 = chaos.corrupt_point("grad.post", a)
+    assert np.array_equal(np.asarray(c4), np.asarray(a))
+    # unarmed site: identity, no copy semantics surprises
+    chaos.reset()
+    assert chaos.corrupt_point("grad.post", a) is a
+
+
+# -- clip_global_norm satellite -----------------------------------------
+def _legacy_clip(arrays, max_norm):
+    total = 0.0
+    for arr in arrays:
+        total = total + (arr.astype("float32") ** 2).sum()
+    total = float(np.sqrt(float(total)))
+    scale = max_norm / (total + 1e-8)
+    out = arrays
+    if scale < 1.0:
+        out = [np.asarray(a * scale) for a in arrays]
+    return total, out
+
+
+def test_clip_global_norm_bit_parity():
+    from mxnet_tpu.gluon.utils import clip_global_norm
+    rng = np.random.RandomState(7)
+    raw = [rng.randn(5, 7).astype("float32"),
+           rng.randn(11).astype("float32"),
+           rng.randn(2, 3, 4).astype("float32")]
+    import jax.numpy as jnp
+    expect_norm, expect = _legacy_clip([jnp.asarray(a) for a in raw],
+                                       1.0)
+    arrs = [mx.nd.array(a) for a in raw]
+    got = clip_global_norm(arrs, 1.0)
+    assert got == expect_norm
+    for a, e in zip(arrs, expect):
+        assert np.array_equal(a.asnumpy(), np.asarray(e))
+    # no-clip case leaves arrays untouched
+    arrs2 = [mx.nd.array(a) for a in raw]
+    clip_global_norm(arrs2, 1e9)
+    for a, r in zip(arrs2, raw):
+        assert np.array_equal(a.asnumpy(), r)
+
+
+def test_clip_global_norm_finite_flag_warns():
+    from mxnet_tpu.gluon.utils import clip_global_norm
+    arrs = [mx.nd.array(np.array([1.0, np.nan], "float32"))]
+    with pytest.warns(UserWarning):
+        clip_global_norm(arrs, 1.0)
+    # check_isfinite=False stays silent
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        clip_global_norm([mx.nd.array(np.array([np.inf], "float32"))],
+                         1.0, check_isfinite=False)
+
+
+# -- telemetry / perf gate ----------------------------------------------
+def test_step_records_carry_skip_fields(tmp_path, monkeypatch):
+    from mxnet_tpu import autograd, gluon
+    tel = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY", tel)
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 3)
+                    .astype("float32"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for poison in (False, False, True):
+        with autograd.record():
+            loss = loss_fn(net(x), mx.nd.zeros((4, 2)))
+        loss.backward()
+        if poison:
+            for p in net.collect_params().values():
+                g = p.grad()
+                g._data = g._data * float("nan")
+        tr.step(4)
+    monkeypatch.delenv("MXTPU_TELEMETRY")
+    from mxnet_tpu.observability.telemetry import close_stream
+    close_stream()
+    recs = [json.loads(line) for line in open(tel)]
+    train = [r for r in recs if r.get("source") == "gluon.trainer"]
+    assert sum(r.get("skipped_steps", 0) for r in train) == 1
+    assert any(r.get("event") == "numerics_skip" for r in recs)
+    # report + gate over the same stream
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from telemetry_report import load_records, summarize
+        import perf_gate
+        s = summarize(load_records(tel))
+        assert s["skipped_steps"] == 1
+        assert s["anomalies"] >= 1
+        assert perf_gate.main([tel, "--max-skipped-steps", "0"]) == 1
+        assert perf_gate.main([tel, "--max-skipped-steps", "1"]) == 0
+    finally:
+        sys.path.remove(os.path.join(ROOT, "tools"))
+
+
+def test_perf_gate_clean_stream_reads_zero_skips(tmp_path):
+    path = str(tmp_path / "clean.jsonl")
+    with open(path, "w") as f:
+        for i in range(3):
+            f.write(json.dumps({"ts": i, "source": "train", "step": i,
+                                "step_time": 0.01}) + "\n")
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import perf_gate
+        assert perf_gate.main([path, "--max-skipped-steps", "0"]) == 0
+    finally:
+        sys.path.remove(os.path.join(ROOT, "tools"))
+
+
+# -- chaos_run --nan-at-step --------------------------------------------
+_NAN_CHILD = """
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+ws = [mx.nd.array(np.ones(8, "float32")),
+      mx.nd.array(np.ones(8, "float32"))]
+gs = [mx.nd.array(np.ones(8, "float32")),
+      mx.nd.array(np.ones(8, "float32"))]
+upd = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+from mxnet_tpu.resilience import numerics
+guard = numerics.NumericsGuard(source="t")
+for step in range(4):
+    upd.update_all([0, 1], gs, ws)
+    guard.step_boundary(step=step)
+print("CHILD_DONE")
+"""
+
+
+def _run_chaos_run(extra_args, extra_env=None, script=_NAN_CHILD):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_run.py")]
+        + extra_args + ["--timeout", "240", "--",
+                        sys.executable, "-c", script],
+        capture_output=True, text=True, env=env, timeout=300)
+
+
+def test_chaos_run_nan_at_step_detects_injection():
+    r = _run_chaos_run(["--nan-at-step", "1", "--expect", "complete"])
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["numerics_markers"] >= 1
+    assert "grad.post" in verdict["chaos_sites"]
+
+
+def test_chaos_run_nan_at_step_fails_without_detection():
+    """The no-injection-detected guard: guard disabled -> no marker ->
+    the run must NOT pass, whatever --expect says."""
+    r = _run_chaos_run(["--nan-at-step", "1", "--expect", "either"],
+                       extra_env={"MXTPU_NUMERICS": "0"})
+    assert r.returncode == 2, r.stdout[-3000:] + r.stderr[-2000:]
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert not verdict["ok"]
+    assert "unproven" in verdict.get("note", "")
+
+
+# -- the slow end-to-end oracle -----------------------------------------
+STEPS = 10
+KILL_STEP = 7        # chaos draw 7 corrupts step 7's packed grads
+
+
+def _worker_cmd(ckpt_dir, out):
+    return [sys.executable,
+            os.path.join(ROOT, "tests", "numerics_worker.py"),
+            "--steps", str(STEPS), "--ckpt-dir", str(ckpt_dir),
+            "--out", str(out)]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("MXTPU_CHAOS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # one bad step is enough evidence in this deterministic worker
+    env["MXTPU_DIVERGE_PATIENCE"] = "1"
+    env.update(extra or {})
+    return env
+
+
+def _read_events(out):
+    with open("%s.r0.jsonl" % out) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.mark.slow
+def test_bitflip_triggers_rollback_resume_bit_identical(tmp_path):
+    """The ISSUE-10 acceptance oracle: a chaos bitflip in step 7's
+    packed gradients (seed 22 flips a top exponent bit -> the
+    corrupted update explodes the float32 loss to inf) must drive
+    divergence rollback (committed steps 6/7 dropped, step 5
+    restored), a supervisor restart labeled as rolled-back (exit 77),
+    and a resumed run whose FINAL PARAMS ARE BIT-IDENTICAL to an
+    uninterrupted run's."""
+    # --- uninterrupted reference ------------------------------------
+    ref = subprocess.run(
+        _worker_cmd(tmp_path / "ck_ref", tmp_path / "ref"),
+        env=_env(), capture_output=True, text=True, timeout=240)
+    assert ref.returncode == 0, ref.stdout[-3000:] + ref.stderr[-2000:]
+    ref_done = [e for e in _read_events(tmp_path / "ref")
+                if e["event"] == "done"]
+    assert len(ref_done) == 1 and ref_done[0]["step"] == STEPS
+
+    # --- chaos run under the supervisor ------------------------------
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "1", "--supervise",
+           "--gang-dir", str(tmp_path / "gang"),
+           "--max-restarts", "2", "--restart-backoff", "0.2",
+           ] + _worker_cmd(tmp_path / "ck", tmp_path / "out")
+    chaos_env = _env({
+        # seed 22: the flip hits bit 30 (top exponent bit) of one
+        # packed-gradient element — deterministically catastrophic
+        "MXTPU_CHAOS_SEED": "22",
+        "MXTPU_CHAOS_RANK_0":
+            "grad.post:kind=bitflip,after=%d,n=1" % (KILL_STEP - 1),
+    })
+    run = subprocess.run(cmd, env=chaos_env, capture_output=True,
+                         text=True, timeout=240)
+    assert run.returncode == 0, run.stdout[-4000:] + run.stderr[-2000:]
+    assert "MXTPU_NUMERICS rollback" in run.stdout, run.stdout[-4000:]
+
+    report = json.loads(open(
+        os.path.join(str(tmp_path / "gang"), "report.json")).read())
+    assert report["restarts"] == 1, report
+    inc = report["incidents"][0]
+    assert inc["diverged"] is True
+    assert inc["exit_code"] == 77
+    assert inc["action"] == "restart (rolled back)"
+
+    events = _read_events(tmp_path / "out")
+    starts = [e for e in events if e["event"] == "start"]
+    assert [e["generation"] for e in starts] == [0, 1]
+    assert starts[0]["restored_step"] is None
+    # first bad observation at step 7 indicts checkpoint 6: trusted 5
+    assert starts[1]["restored_step"] == KILL_STEP - 2
+    done = [e for e in events if e["event"] == "done"]
+    assert len(done) == 1 and done[0]["step"] == STEPS
+    # the acceptance oracle: bit-identical to the uninterrupted run
+    assert done[0]["params_hex"] == ref_done[0]["params_hex"]
+    # the suspect committed steps are gone; the resumed run re-saved
+    # them from clean state
+    ck_steps = sorted(int(d) for d in os.listdir(str(tmp_path / "ck"))
+                      if d.isdigit())
+    assert STEPS in ck_steps
+
+
+@pytest.mark.slow
+def test_numerics_guard_overhead_within_budget():
+    """ISSUE-10 acceptance: happy-path guard overhead <= 2% step time
+    on the CPU bench probe (min-of-3, dispatch-bound worst case). The
+    budget gets slack for 1-core CI noise; the recorded BENCH number
+    is the authoritative one."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+        pct = bench._numerics_overhead_pct(steps=120, warmup=30)
+    finally:
+        sys.path.remove(ROOT)
+    assert pct <= 10.0, "numerics guard overhead %.2f%%" % pct

@@ -24,6 +24,13 @@ injected death happens exactly once), and rank R SIGKILLs itself at
 training-step boundary K+1 — the end-to-end gang-restart proof
 (docs/fault_tolerance.md).
 
+Numerics mode (`--nan-at-step K`, mirrors --kill-rank): arm
+`grad.post:kind=nan,after=K,n=1` — one NaN lands in a packed gradient
+flat after K clean draws, and the training numerics guard must skip
+that group in-graph and print its `MXTPU_NUMERICS anomaly` marker. A
+run that finishes without the marker FAILS regardless of --expect (the
+no-injection-detected guard): a missed injection can't report a pass.
+
 Exit codes: 0 outcome matched --expect; 2 outcome mismatched; 3 hang.
 Runnable from the bench harness (plain argv contract, single JSON
 summary line on stdout).
@@ -62,6 +69,21 @@ def main(argv=None):
                     help="arm worker.kill (kind=kill) on this rank only "
                          "via MXTPU_CHAOS_RANK_<R> — the gang-restart "
                          "chaos mode")
+    ap.add_argument("--nan-at-step", type=int, default=None,
+                    help="arm grad.post:kind=nan so update group K+1 "
+                         "gets one NaN gradient element — the numerics-"
+                         "guard skip proof (mirrors --kill-rank). The "
+                         "run must emit an MXTPU_NUMERICS marker or it "
+                         "FAILS: a missed injection cannot report a "
+                         "pass")
+    ap.add_argument("--nan-rank", type=int, default=None,
+                    help="with --nan-at-step against a SUPERVISED "
+                         "gang: arm the injection via "
+                         "MXTPU_CHAOS_RANK_<R> instead of the global "
+                         "MXTPU_CHAOS, so the GangSupervisor strips it "
+                         "from relaunched generations — a global spec "
+                         "would re-inject after every rollback and "
+                         "loop the restart budget away")
     ap.add_argument("--after-steps", type=int, default=0,
                     help="with --kill-rank: survive this many training "
                          "steps before the SIGKILL (default 0: die at "
@@ -83,19 +105,40 @@ def main(argv=None):
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         ap.error("no command given (put it after --)")
-    if args.chaos is None and args.kill_rank is None:
-        ap.error("need --chaos and/or --kill-rank")
+    if args.chaos is None and args.kill_rank is None \
+            and args.nan_at_step is None:
+        ap.error("need --chaos, --kill-rank and/or --nan-at-step")
     if args.kill_rank is not None and args.kill_rank < 0:
         ap.error("--kill-rank must be a non-negative rank id")
+    if args.nan_at_step is not None and args.nan_at_step < 0:
+        ap.error("--nan-at-step must be a non-negative step index")
 
     # validate the spec HERE: a typo'd spec silently injecting nothing
     # would report a meaningless pass
     from mxnet_tpu.resilience.chaos import parse_spec
     env = dict(os.environ, MXTPU_CHAOS_SEED=str(args.seed))
+    chaos_spec = args.chaos
+    if args.nan_at_step is not None:
+        # one NaN into the packed gradient flat after `--nan-at-step`
+        # clean draws: the numerics guard must skip that group and
+        # print its MXTPU_NUMERICS marker (checked below). With
+        # --nan-rank the spec rides the per-rank env var (read only by
+        # that rank, stripped from relaunched generations by the
+        # GangSupervisor — the --kill-rank plumbing); without it the
+        # spec is global, for unsupervised single-process targets
+        nan_spec = "grad.post:kind=nan,after=%d,n=1" % args.nan_at_step
+        if args.nan_rank is not None:
+            env["MXTPU_CHAOS_RANK_%d" % args.nan_rank] = nan_spec
+        else:
+            chaos_spec = ";".join(filter(None, [chaos_spec, nan_spec]))
+    elif args.nan_rank is not None:
+        ap.error("--nan-rank needs --nan-at-step")
     sites = []
-    if args.chaos is not None:
-        sites += sorted(parse_spec(args.chaos))
-        env["MXTPU_CHAOS"] = args.chaos
+    if args.nan_at_step is not None and args.nan_rank is not None:
+        sites += sorted(parse_spec(nan_spec))
+    if chaos_spec is not None:
+        sites += sorted(parse_spec(chaos_spec))
+        env["MXTPU_CHAOS"] = chaos_spec
     if args.kill_rank is not None:
         kill_spec = "worker.kill:kind=kill,after=%d" % max(
             0, args.after_steps)
@@ -124,6 +167,23 @@ def main(argv=None):
                "elapsed_s": round(time.time() - t0, 2),
                "chaos_sites": sites,
                "tail": tail[-2000:]}
+    if args.nan_at_step is not None and outcome in ("COMPLETED",
+                                                    "CLEAN_ERROR"):
+        # no-injection-detected guard: the numerics guard prints an
+        # `MXTPU_NUMERICS anomaly ...` marker when it skips the
+        # poisoned group. A run that finished WITHOUT one means the
+        # injection never fired (site unreached, guard disabled) — a
+        # meaningless pass that must fail loudly instead
+        detected = [ln for ln in (out or "").splitlines()
+                    if ln.startswith("MXTPU_NUMERICS")]
+        summary["numerics_markers"] = len(detected)
+        if not detected:
+            ok = summary["ok"] = False
+            summary["note"] = (
+                "--nan-at-step %d unproven: the command finished but "
+                "emitted no MXTPU_NUMERICS marker — the grad.post "
+                "injection was never detected (site unreached, or the "
+                "guard is off: MXTPU_NUMERICS=0)" % args.nan_at_step)
     if args.kill_rank is not None and outcome == "COMPLETED":
         # a kill that never fired (rank id outside the gang, site
         # unreached) completing "cleanly" is the meaningless pass the

@@ -21,6 +21,12 @@ is rejected):
     --max-compiles        total XLA backend compiles
     --min-samples-per-sec aggregate training throughput floor
     --max-data-wait-frac  data-wait seconds / total step time
+    --max-skipped-steps   numerics-guard skipped-step budget: a run
+                          whose steps were silently skipped (NaN
+                          gradients preserved pre-step state) must
+                          FAIL the gate instead of posting a fake
+                          throughput number (docs/fault_tolerance.md)
+    --max-anomalies       same, over the anomaly count (skips + spikes)
     --min-steps           refuse a stream shorter than this (default 1
                           — a truncated run must not "pass")
 
@@ -76,6 +82,8 @@ def evaluate(summary, args):
             else None
         checks.append(("data_wait_frac", frac, args.max_data_wait_frac,
                        frac is not None and frac <= args.max_data_wait_frac))
+    check("skipped_steps", "skipped_steps", args.max_skipped_steps, le)
+    check("anomalies", "anomalies", args.max_anomalies, le)
     check("steps", "steps", args.min_steps, ge)
     return checks
 
@@ -92,13 +100,16 @@ def main(argv=None):
     ap.add_argument("--max-compiles", type=float, default=None)
     ap.add_argument("--min-samples-per-sec", type=float, default=None)
     ap.add_argument("--max-data-wait-frac", type=float, default=None)
+    ap.add_argument("--max-skipped-steps", type=float, default=None)
+    ap.add_argument("--max-anomalies", type=float, default=None)
     ap.add_argument("--min-steps", type=float, default=1)
     args = ap.parse_args(argv)
 
     budgets = (args.max_step_p50_s, args.max_step_p95_s,
                args.max_step_mean_s, args.max_compile_stall_s,
                args.max_compiles, args.min_samples_per_sec,
-               args.max_data_wait_frac)
+               args.max_data_wait_frac, args.max_skipped_steps,
+               args.max_anomalies)
     verdict = {"path": args.path, "ok": False, "breaches": []}
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"

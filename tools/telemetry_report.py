@@ -198,6 +198,36 @@ def summarize(records):
             summary["decode_intertoken_p50_s"] = _percentile(gaps, 0.50)
             summary["decode_intertoken_p95_s"] = _percentile(gaps, 0.95)
             summary["decode_intertoken_p99_s"] = _percentile(gaps, 0.99)
+    # numerics section (docs/fault_tolerance.md "Training numerics
+    # guard"): skipped_steps/anomalies are per-step counter deltas on
+    # TRAINING records (the resilience events describing the same
+    # incidents are counted separately, not summed twice), loss_scale
+    # is the newest gauge value seen, rollback/SDC events come from
+    # the resilience stream
+    skipped = sum(int(r.get("skipped_steps", 0)) for r in core)
+    anomalies = sum(int(r.get("anomalies", 0)) for r in core)
+    num_events = [r for r in records if r.get("source") == "resilience"
+                  and str(r.get("event", "")).startswith(
+                      ("numerics_", "sdc_", "anomaly_"))]
+    scales = [r["loss_scale"] for r in records
+              if isinstance(r.get("loss_scale"), (int, float))]
+    if skipped or anomalies or num_events or scales:
+        summary["skipped_steps"] = skipped
+        summary["anomalies"] = anomalies
+        summary["numerics_rollbacks"] = sum(
+            1 for r in num_events if r.get("event") == "numerics_rollback")
+        sdc = [r for r in num_events if r.get("event") == "sdc_suspected"]
+        summary["sdc_suspected"] = len(sdc)
+        if sdc:
+            summary["sdc_devices"] = sorted(
+                {str(r.get("device", "?")) for r in sdc})
+        if scales:
+            summary["loss_scale_last"] = float(scales[-1])
+    else:
+        # always-present zeros for the gate: a --max-skipped-steps
+        # budget must read 0, not "metric absent", on a clean stream
+        summary["skipped_steps"] = 0
+        summary["anomalies"] = 0
     # lease/watchdog section (docs/fault_tolerance.md): DeviceLease and
     # HealthWatchdog emit source="resilience" events — step_time is the
     # event's duration (acquire wait, takeover time, tripped budget)
@@ -332,6 +362,19 @@ def format_summary(s):
                    s["decode_intertoken_p95_s"],
                    s["decode_intertoken_p99_s"],
                    s.get("decode_step_p50_s", 0.0)))
+    if s.get("skipped_steps") or s.get("anomalies") \
+            or s.get("numerics_rollbacks") or s.get("sdc_suspected") \
+            or "loss_scale_last" in s:
+        lines.append(
+            "  numerics    %d skipped step(s)  %d anomalies  "
+            "%d rollback(s)  %d SDC suspected%s"
+            % (s.get("skipped_steps", 0), s.get("anomalies", 0),
+               s.get("numerics_rollbacks", 0), s.get("sdc_suspected", 0),
+               ("  devices %s" % ", ".join(s["sdc_devices"])
+                if s.get("sdc_devices") else "")))
+        if "loss_scale_last" in s:
+            lines.append("              loss scale %g"
+                         % s["loss_scale_last"])
     if "lease_acquires" in s or "watchdog_trips" in s:
         lines.append(
             "  lease       %d acquires (p95 %.4fs)  %d takeovers%s"
