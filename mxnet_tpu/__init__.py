@@ -53,6 +53,14 @@ from . import gluon
 from . import parallel
 from . import observability
 from . import resilience
+from . import compile  # noqa: A004 — mx.compile, the artifact subsystem
+# activate the persistent compilation cache EAGERLY: code that compiles
+# through raw jax before touching a Context (bench.py's measurement
+# windows) must already be behind the multi-device read guard — a
+# cache-deserialized multi-device CPU executable can segfault jaxlib
+# (docs/compilation.md). Env-driven and idempotent; MXTPU_COMPILE_CACHE=0
+# disables.
+compile.cache.enable_cache()
 from . import serving
 from . import test_utils
 from . import monitor

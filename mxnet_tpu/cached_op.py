@@ -70,6 +70,8 @@ class CachedOp:
     def _fwd(self, mode):
         if mode not in self._fwd_jits:
             _JIT_BUILDS.inc(op=self._stub.name, mode=mode, direction="fwd")
+            from .compile.cache import enable_cache
+            enable_cache()   # flag check after the first build
             fn, _, _, needs_rng = build_graph_fn(self._symbol._entries, mode)
             self._fwd_jits[mode] = (jax.jit(fn), needs_rng)
         return self._fwd_jits[mode]

@@ -1,0 +1,166 @@
+"""Cold start as a first-class metric (docs/compilation.md).
+
+"Cold start" here is **process boot → first useful dispatch**: the
+window a serving rollout's `warmup()` gate or a supervised gang's
+relaunched generation spends compiling before it does any work. This
+module measures it from the kernel's own record of when the process
+started (`/proc/self/stat` starttime + `/proc/stat` btime — no
+cooperation from the entrypoint needed), captures the compile-side
+counters accumulated in that window (XLA compile seconds, persistent
+cache hits/misses, AOT loads/fallbacks), and publishes one record per
+process:
+
+- a ``source="compile", event="cold_start"`` line on the
+  ``MXTPU_TELEMETRY`` stream (``step_time`` = cold-start seconds, so
+  `tools/telemetry_report.py`'s compile section and
+  `tools/perf_gate.py --max-cold-start-s` can budget it);
+- a ``compile.cold_start.seconds`` gauge (label ``what``);
+- when ``MXTPU_GANG_DIR`` is set (supervised rank), one JSON line
+  appended to ``<gang_dir>/coldstart.jsonl`` carrying the rank and
+  gang generation — `GangSupervisor.report()` reads these to split
+  restart downtime into relaunch vs recompile.
+
+`mark_ready` fires once per process (the first ready moment wins:
+serving marks at `ModelServer.start()`, training at the first
+`at_step_boundary()`); later calls are a no-op unless forced.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..observability import registry as _obs
+from ..observability import telemetry as _telemetry
+
+__all__ = ["process_start_time", "mark_ready", "marked", "cold_record"]
+
+COLD_SECONDS = _obs.gauge(
+    "compile.cold_start.seconds",
+    "process boot -> first useful dispatch (label what: serving/train)")
+
+_IMPORT_WALL = time.time()
+_lock = threading.Lock()
+_state = {"record": None}
+
+
+def _proc_start_epoch():
+    """Process start as a wall-clock epoch from the kernel: /proc/stat
+    btime + starttime jiffies / CLK_TCK. Raises on non-Linux."""
+    with open("/proc/self/stat", "rb") as f:
+        stat = f.read().decode("ascii", "replace")
+    # field 22 (1-indexed) AFTER the parenthesized comm, which may
+    # itself contain spaces — split from the last ')'
+    fields = stat.rsplit(")", 1)[1].split()
+    starttime_jiffies = float(fields[19])
+    btime = None
+    with open("/proc/stat", "rb") as f:
+        for line in f:
+            if line.startswith(b"btime "):
+                btime = float(line.split()[1])
+                break
+    if btime is None:
+        raise OSError("no btime in /proc/stat")
+    return btime + starttime_jiffies / float(os.sysconf("SC_CLK_TCK"))
+
+
+def process_start_time():
+    """Epoch seconds this process started, from /proc when available
+    (the honest boot anchor — it predates the interpreter, so import
+    time is inside the measured window), else the wall clock at this
+    module's import."""
+    try:
+        return _proc_start_epoch()
+    except (OSError, IndexError, ValueError):
+        return _IMPORT_WALL
+
+
+def _counter_total(name):
+    m = _obs.REGISTRY.get(name)
+    return m.total() if m is not None and hasattr(m, "total") else 0
+
+
+def _rank():
+    for var in ("JAX_PROCESS_ID", "DMLC_WORKER_ID"):
+        val = os.environ.get(var)
+        if val is not None:
+            try:
+                return int(val)
+            except ValueError:
+                pass
+    return 0
+
+
+def _append_gang_record(record):
+    gang_dir = os.environ.get("MXTPU_GANG_DIR")
+    if not gang_dir:
+        return
+    line = json.dumps(record, sort_keys=True) + "\n"
+    try:
+        # O_APPEND single-line write: atomic for lines under PIPE_BUF,
+        # so N ranks appending concurrently never tear each other
+        fd = os.open(os.path.join(gang_dir, "coldstart.jsonl"),
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def marked():
+    """True once this process published its cold-start record."""
+    return _state["record"] is not None
+
+
+def cold_record():
+    """The published record, or None before `mark_ready`."""
+    return _state["record"]
+
+
+def mark_ready(what, force=False, **extra):
+    """Declare this process ready (first useful dispatch is done).
+    First call wins and returns the record; later calls return None
+    unless `force=True` (tests / multi-phase processes that want a
+    second marker)."""
+    with _lock:
+        if _state["record"] is not None and not force:
+            return None
+        now = time.time()
+        record = {
+            "ts": now,
+            "source": "compile",
+            "event": "cold_start",
+            "what": str(what),
+            # step_time carries the headline number so the existing
+            # telemetry tooling (strict step_time schema) accepts it
+            "step_time": max(0.0, now - process_start_time()),
+            "compile_count": int(_counter_total("xla.compile.count")),
+            "compile_seconds": float(
+                _counter_total("xla.compile.seconds")),
+            "cache_hits": int(_counter_total("compile.cache.hits")),
+            "cache_misses": int(_counter_total("compile.cache.misses")),
+            "aot_loads": int(_counter_total("compile.aot.loads")),
+            "aot_fallbacks": int(
+                _counter_total("compile.aot.fallbacks")),
+            "rank": _rank(),
+        }
+        gen = os.environ.get("MXTPU_GANG_GENERATION")
+        if gen is not None:
+            try:
+                record["generation"] = int(gen)
+            except ValueError:
+                pass
+        record.update(extra)
+        _state["record"] = record
+    COLD_SECONDS.set(record["step_time"], what=record["what"])
+    _telemetry.emit(record)
+    _append_gang_record(record)
+    return record
+
+
+def _reset_for_tests():
+    with _lock:
+        _state["record"] = None

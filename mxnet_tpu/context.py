@@ -91,6 +91,12 @@ def _ensure_backend_alive():
     if _backend_guard["checked"]:
         return
     from .base import getenv
+    # first backend touch is also the compile entry point: activate the
+    # persistent compilation cache BEFORE anything can compile, so a
+    # restarted process replays executables instead of re-lowering them
+    # (docs/compilation.md; MXTPU_COMPILE_CACHE=0 disables)
+    from .compile.cache import enable_cache
+    enable_cache()
     timeout = getenv("MXTPU_WATCHDOG_INIT_S", 180.0)
     if timeout > 0:
         from .resilience.watchdog import HealthWatchdog

@@ -187,6 +187,10 @@ def _counters_snapshot():
     return {
         "compile_count": COMPILE_COUNT.total(),
         "compile_seconds": COMPILE_SECONDS.total(),
+        # persistent-compilation-cache hits/misses (compile/cache.py):
+        # on a warm cache, compile_count reads 0 and the hits say why
+        "compile_cache_hits": _counter_total("compile.cache.hits"),
+        "compile_cache_misses": _counter_total("compile.cache.misses"),
         "kvstore_bytes": sum(c.total() for c in _KV_BYTE_COUNTERS),
         "data_wait": _BATCH_WAIT.total_sum(),
         "allreduce_calls": _counter_total("kvstore.allreduce.calls"),
@@ -304,7 +308,8 @@ class StepTimer:
         # allreduce/bucket deltas (tools/telemetry_report.py's
         # allreduce section); zero-valued fields are omitted so
         # single-process step records stay the size they were
-        for field in ("allreduce_calls", "allreduce_bytes",
+        for field in ("compile_cache_hits", "compile_cache_misses",
+                      "allreduce_calls", "allreduce_bytes",
                       "allreduce_seconds", "bucket_count",
                       "bucket_fill_sum", "bucket_pack_seconds",
                       "bucket_unpack_seconds", "update_dispatches",

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import getenv
+from ..compile import aot as _aot
 from ..ndarray import NDArray
 from ..observability import registry as _obs
 from .. import optimizer as opt
@@ -203,10 +204,74 @@ def _jit_for(spec, donate, guarded=None):
     key = (spec.name, bool(donate), bool(guarded))
     fn = _JITS.get(key)
     if fn is None:
+        from ..compile.cache import enable_cache
+        enable_cache()    # kernel build is a compile entry point
         body = _guard_wrap(spec.fn) if guarded else spec.fn
         fn = _JITS[key] = jax.jit(
             body, static_argnums=(5, 6),
             donate_argnums=(0, 2) if donate else ())
+    return fn
+
+
+# -- ahead-of-time fused kernels (docs/compilation.md) ----------------------
+# The fused-update program set is fixed once the model and optimizer
+# are: one kernel per (optimizer class, guard, donation, group layout,
+# static hypers). With MXTPU_AOT_STORE set, each group signature tries
+# its serialized executable first; with MXTPU_AOT_EXPORT=1 a miss is
+# compiled ahead of time (`jit.lower().compile()`) and captured into
+# the store — how `tools/aot_build.py --train` harvests kernels whose
+# layouts only exist once real shapes flow.
+_AOT = {}    # signature -> loaded executable, or False (known miss)
+
+
+def _aot_sig(spec, donate, guarded, w_flat, g_flat, state_flats, wd,
+             hyper):
+    return (spec.name, bool(donate), bool(guarded),
+            tuple(w_flat.shape), str(w_flat.dtype), str(g_flat.dtype),
+            tuple((tuple(s.shape), str(s.dtype)) for s in state_flats),
+            wd, hyper)
+
+
+def _aot_kernel(spec, donate, guarded, w_flat, g_flat, state_flats,
+                wd, hyper):
+    """The AOT executable for one group signature, or None (JIT path).
+    lr/t stay traced inputs (they change per step); wd/hyper are baked
+    into the exported closure exactly as static_argnums bakes them into
+    the jit program, and both ride the fingerprint."""
+    store = _aot.default_store()
+    if store is None:
+        return None
+    sig = _aot_sig(spec, donate, guarded, w_flat, g_flat, state_flats,
+                   wd, hyper)
+    cached = _AOT.get(sig)
+    if cached is not None:
+        return cached or None
+    avals = (jax.ShapeDtypeStruct(w_flat.shape, w_flat.dtype),
+             jax.ShapeDtypeStruct(g_flat.shape, g_flat.dtype),
+             tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                   for s in state_flats),
+             jax.ShapeDtypeStruct((), jnp.float32),
+             jax.ShapeDtypeStruct((), jnp.int32))
+    extra = {"kind": "fused_update", "spec": spec.name,
+             "donate": bool(donate), "guarded": bool(guarded),
+             "wd": wd, "hyper": hyper,
+             "args": _aot.aval_signature(avals)}
+    name = "fused/%s/%s" % (spec.name, _aot.fingerprint(extra)[:16])
+    fn = store.load_jit(name, extra)
+    if fn is None and _aot.export_enabled():
+        body = _guard_wrap(spec.fn) if guarded else spec.fn
+
+        def kernel(w, g, states, lr, t):
+            return body(w, g, states, lr, t, wd, hyper)
+
+        try:
+            jitted = jax.jit(kernel,
+                             donate_argnums=(0, 2) if donate else ())
+            fn = _aot.compile_fresh(jitted, avals)
+            store.put(name, _aot.fingerprint(extra), fn)
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            fn = None
+    _AOT[sig] = fn or False
     return fn
 
 
@@ -398,8 +463,35 @@ class FusedUpdater(opt.Updater):
         lr, wd = group[0].lr, group[0].wd
         t0 = time.perf_counter()
         guarded = _num.enabled()
-        out = _jit_for(spec, donate, guarded)(
-            w_flat, g_flat, state_flats, lr, t, wd, spec.hyper(o))
+        out = None
+        hyper = spec.hyper(o)
+        aot_fn = _aot_kernel(spec, donate, guarded, w_flat, g_flat,
+                             state_flats, wd, hyper)
+        if aot_fn is not None:
+            try:
+                out = aot_fn(w_flat, g_flat, state_flats,
+                             jnp.float32(lr), jnp.int32(t))
+            except (TypeError, ValueError):
+                # signature/aval refusal happens BEFORE execution, so
+                # the donated flats are intact: latch this signature
+                # to the known-miss sentinel (never reload a broken
+                # executable every step) and take the JIT path. The
+                # sig is rebuilt HERE, not on the hot path — failure
+                # is the rare case
+                _AOT[_aot_sig(spec, donate, guarded, w_flat, g_flat,
+                              state_flats, wd, hyper)] = False
+                _aot.FALLBACKS.inc(reason="dispatch")
+            except Exception:
+                # a failure DURING execution may have consumed the
+                # donated weight/state flats — re-dispatching them
+                # would corrupt the update; latch and surface
+                _AOT[_aot_sig(spec, donate, guarded, w_flat, g_flat,
+                              state_flats, wd, hyper)] = False
+                _aot.FALLBACKS.inc(reason="dispatch")
+                raise
+        if out is None:
+            out = _jit_for(spec, donate, guarded)(
+                w_flat, g_flat, state_flats, lr, t, wd, hyper)
         if guarded:
             new_w, new_states, ok = out
             # device scalar only — resolved at the guard's next step

@@ -51,6 +51,7 @@ class TrainingPreempted(MXNetError):
 
 _requested = {"sig": None}
 _guards = []  # stack of active PreemptionGuards
+_cold = {"boundaries": 0}
 
 
 def _handler(signum, frame):
@@ -83,7 +84,21 @@ def at_step_boundary():
     Also the `worker.kill` chaos site: `kind=kill` SIGKILLs this rank
     mid-run — the gang-supervision proof (a dead rank must yield fast
     peer detection, supervisor teardown, and a committed-checkpoint
-    resume, docs/fault_tolerance.md)."""
+    resume, docs/fault_tolerance.md).
+
+    And the training-side cold-start marker: every loop (gluon
+    Trainer, ShardedTrainer, module fit) passes here, so one counter
+    check publishes the compile/cold-start record a supervised gang's
+    downtime split reads (docs/compilation.md). It fires at the
+    SECOND boundary, not the first — the boundary sits at the top of
+    the step, so only the second one has the whole first step
+    (forward/backward AND the fused-update kernel compiles) inside
+    the measured window."""
+    if _cold["boundaries"] < 2:
+        _cold["boundaries"] += 1
+        if _cold["boundaries"] == 2:
+            from ..compile import coldstart as _coldstart
+            _coldstart.mark_ready("train")
     chaos_point("worker.kill")
     sig = _requested["sig"]
     if sig is None or not _guards:

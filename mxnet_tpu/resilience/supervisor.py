@@ -571,6 +571,13 @@ class GangSupervisor:
                 os.unlink(os.path.join(self.dir, name))
             except OSError:
                 pass
+        if self.generation == 0:
+            # a reused gang dir must not attribute a PREVIOUS run's
+            # cold-start records to this run's downtime split
+            try:
+                os.unlink(os.path.join(self.dir, "coldstart.jsonl"))
+            except OSError:
+                pass
         self._write_record()
         self._ensure_heartbeat_thread()
         coordinator = "127.0.0.1:%d" % _free_port()
@@ -781,10 +788,72 @@ class GangSupervisor:
             time.sleep(self.poll_s)
 
     # -- reporting -----------------------------------------------------
+    def _read_cold_starts(self):
+        """Per-generation cold-start summaries from the records every
+        rank appends to <gang_dir>/coldstart.jsonl at its first useful
+        dispatch (compile/coldstart.py). Torn/foreign lines are
+        skipped — the report degrades, it never crashes."""
+        per_gen = {}
+        try:
+            with open(os.path.join(self.dir, "coldstart.jsonl")) as f:
+                lines = f.readlines()
+        except OSError:
+            return per_gen
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            gen = rec.get("generation", 0)
+            if not isinstance(gen, int):
+                continue
+            g = per_gen.setdefault(gen, {
+                "ranks": 0, "cold_start_max_s": 0.0,
+                "compile_s_max": 0.0, "compile_count": 0,
+                "cache_hits": 0, "cache_misses": 0, "aot_loads": 0,
+                "aot_fallbacks": 0})
+            g["ranks"] += 1
+            g["cold_start_max_s"] = round(max(
+                g["cold_start_max_s"],
+                float(rec.get("step_time", 0.0))), 3)
+            g["compile_s_max"] = round(max(
+                g["compile_s_max"],
+                float(rec.get("compile_seconds", 0.0))), 3)
+            for field in ("compile_count", "cache_hits", "cache_misses",
+                          "aot_loads", "aot_fallbacks"):
+                g[field] += int(rec.get(field, 0))
+        return per_gen
+
     def report(self):
-        return {"nranks": self.nranks, "generation": self.generation,
-                "restarts": self.restarts, "gang_dir": self.dir,
-                "incidents": list(self.incidents)}
+        """The gang's lifecycle report. Each restart incident's
+        downtime is split into **relaunch** (failure detection →
+        processes respawned — what the supervisor itself did) and
+        **recompile** (XLA compile seconds the relaunched generation
+        paid before its first step — what the compilation artifact
+        subsystem exists to erase: with a warm persistent cache or an
+        AOT store it reads ~0)."""
+        cold = self._read_cold_starts()
+        incidents = []
+        for inc in self.incidents:
+            inc = dict(inc)
+            if str(inc.get("action", "")).startswith("restart"):
+                after = cold.get(inc["generation"] + 1)
+                if after is not None:
+                    inc["downtime_split"] = {
+                        "relaunch_s": inc.get("downtime_s"),
+                        "recompile_s": after["compile_s_max"],
+                        "rank_ready_max_s": after["cold_start_max_s"],
+                    }
+            incidents.append(inc)
+        out = {"nranks": self.nranks, "generation": self.generation,
+               "restarts": self.restarts, "gang_dir": self.dir,
+               "incidents": incidents}
+        if cold:
+            out["cold_starts"] = {str(g): s
+                                  for g, s in sorted(cold.items())}
+        return out
 
     def _write_report(self):
         try:

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError, getenv
+from ..compile import aot as _aot
+from ..compile.cache import enable_cache
 from ..observability import registry as _obs
 from .engine import bucket_sizes, resolve_serve_dtype
 
@@ -122,6 +124,7 @@ class DecodeEngine:
             positions = positions.at[slot].set(length)
             return cache_k, cache_v, positions
 
+        enable_cache()    # an engine freeze is a compile entry point
         self._prefill_jit = jax.jit(prefill)
         donate_state = (0, 1, 2) if self._donate else ()
         self._admit_jit = jax.jit(admit, donate_argnums=donate_state)
@@ -131,6 +134,8 @@ class DecodeEngine:
 
         self._lock = threading.Lock()
         self._compiled = {}          # kind or ("prefill", bucket) -> 1
+        self._aot = {}               # "admit"/"step"/("prefill", b) ->
+        #                              deserialized AOT executable
         self.steps = 0
         self.reset()
 
@@ -214,6 +219,144 @@ class DecodeEngine:
         raise AssertionError("unreachable")
 
     # ------------------------------------------------------------------
+    # ahead-of-time executables (docs/compilation.md)
+    # ------------------------------------------------------------------
+    def _aot_abstract(self, kind, bucket=None):
+        """Abstract argument tree for one decode program — exactly the
+        avals prefill()/step() dispatch with."""
+        params = _aot.abstract(self._params)
+        cache_k = _aot.abstract(self._cache_k)
+        cache_v = _aot.abstract(self._cache_v)
+        positions = jax.ShapeDtypeStruct((self.max_slots,), jnp.int32)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        if kind == "step":
+            return (params, cache_k, cache_v, positions,
+                    jax.ShapeDtypeStruct((self.max_slots,), jnp.bool_),
+                    jax.ShapeDtypeStruct((self.max_slots,), jnp.int32))
+        if kind == "admit":
+            # k_seq/v_seq: one sequence's K/V — the cache shape with
+            # the slot axis (axis 1) removed
+            seq_k = jax.ShapeDtypeStruct(
+                self._cache_k.shape[:1] + self._cache_k.shape[2:],
+                self._cache_k.dtype)
+            seq_v = jax.ShapeDtypeStruct(
+                self._cache_v.shape[:1] + self._cache_v.shape[2:],
+                self._cache_v.dtype)
+            return (cache_k, cache_v, positions, seq_k, seq_v, i32, i32)
+        if kind == "prefill":
+            return (params,
+                    jax.ShapeDtypeStruct((1, int(bucket)), jnp.int32),
+                    i32)
+        raise MXNetError("unknown decode program kind %r" % (kind,))
+
+    def _aot_key_material(self, kind, bucket=None):
+        return {"kind": "decode_engine", "program": kind,
+                "bucket": None if bucket is None else int(bucket),
+                "args": _aot.aval_signature(self._aot_abstract(
+                    kind, bucket)),
+                "max_slots": self.max_slots,
+                "max_seq_len": self.max_seq_len,
+                "dtype": self.dtype, "donate": self._donate}
+
+    def _aot_name(self, kind, bucket=None):
+        base = "decode/%s/%s" % (self.name, kind)
+        return base if bucket is None else "%s/b%d" % (base, bucket)
+
+    def _aot_programs(self, buckets=None):
+        yield "admit", None
+        yield "step", None
+        for b in (self._buckets if buckets is None else buckets):
+            yield "prefill", self.bucket_for(b)
+
+    def aot_export(self, store, buckets=None, verify=True):
+        """Serialize the engine's whole fixed program set — admit,
+        step, and the prefill buckets — into `store`; with `verify`
+        (default) each blob is proven loadable in a fresh interpreter
+        and unprovable ones pruned. Returns the (program-name,
+        fingerprint) list that survived."""
+        if not isinstance(store, _aot.ArtifactStore):
+            store = _aot.ArtifactStore(store, create=True)
+        jits = {"admit": self._admit_jit, "step": self._step_jit,
+                "prefill": self._prefill_jit}
+        out = []
+        for kind, b in self._aot_programs(buckets):
+            fp, _ = _aot.export_jit(
+                store, self._aot_name(kind, b), jits[kind],
+                self._aot_abstract(kind, b),
+                self._aot_key_material(kind, b))
+            out.append((self._aot_name(kind, b), fp))
+        if verify and out:
+            ok = store.verify_and_prune([n for n, _ in out])
+            out = [(n, fp) for n, fp in out if ok.get(n, True)]
+        return out
+
+    def aot_load(self, store, buckets=None):
+        """Load serialized decode programs from `store`; any mismatch
+        keeps that program on the JIT path. Replica engines pinned off
+        the default device skip the load entirely (their executables
+        would target the wrong device). Returns the program keys
+        loaded."""
+        if not isinstance(store, _aot.ArtifactStore):
+            store = _aot.ArtifactStore(store)
+        if self.device is not None and \
+                self.device != jax.local_devices()[0]:
+            _aot.FALLBACKS.inc(reason="device")
+            return []
+        loaded = []
+        for kind, b in self._aot_programs(buckets):
+            fn = store.load_jit(self._aot_name(kind, b),
+                                self._aot_key_material(kind, b))
+            if fn is not None:
+                key = kind if b is None else (kind, b)
+                with self._lock:
+                    self._aot[key] = fn
+                loaded.append(key)
+        if loaded:
+            store.hold(what="decode:%s" % self.name)
+        return loaded
+
+    def _aot_call(self, key, args):
+        """Dispatch one decode program through its AOT executable when
+        loaded; returns the outputs or None (JIT path).
+
+        Fallback is only safe BEFORE execution: jax's signature/aval
+        validation raises TypeError/ValueError without touching the
+        arguments, so the donated KV-cache buffers are intact and the
+        JIT program can re-dispatch them. A failure DURING execution
+        may already have consumed the donated state — re-dispatching
+        deleted arrays would corrupt the engine — so it drops the
+        executable, counts the fallback, and re-raises (the scheduler
+        already treats a step error as fatal for in-flight
+        sequences)."""
+        fn = self._aot.get(key)
+        if fn is None:
+            return None
+        try:
+            out = fn(*args)
+            # the program is in use: keep the census ("admit + step ==
+            # 2, always") true on an AOT-warm engine too — without
+            # touching the compile METRIC, since nothing compiled
+            # (same contract as InferenceEngine.infer)
+            with self._lock:
+                self._compiled.setdefault(key, 1)
+            return out
+        except (TypeError, ValueError):
+            with self._lock:
+                self._aot.pop(key, None)
+            _aot.FALLBACKS.inc(reason="dispatch")
+            return None
+        except Exception:
+            with self._lock:
+                self._aot.pop(key, None)
+            _aot.FALLBACKS.inc(reason="dispatch")
+            raise
+
+    @property
+    def aot_programs(self):
+        with self._lock:
+            return sorted(str(k) for k in self._aot)
+
+    # ------------------------------------------------------------------
     # the three programs
     # ------------------------------------------------------------------
     def prefill(self, tokens, slot):
@@ -235,12 +378,18 @@ class DecodeEngine:
             args = (self._params,
                     jax.device_put(jnp.asarray(padded), self.device),
                     jax.device_put(jnp.int32(n), self.device))
-        next_token, k_seq, v_seq = self._prefill_jit(*args)
-        self._count_compile(("prefill", bucket))
-        self._cache_k, self._cache_v, self._positions = self._admit_jit(
-            self._cache_k, self._cache_v, self._positions,
-            k_seq, v_seq, jnp.int32(slot), jnp.int32(n))
-        self._count_compile("admit")
+        out = self._aot_call(("prefill", bucket), args)
+        if out is None:
+            out = self._prefill_jit(*args)
+            self._count_compile(("prefill", bucket))
+        next_token, k_seq, v_seq = out
+        admit_args = (self._cache_k, self._cache_v, self._positions,
+                      k_seq, v_seq, jnp.int32(slot), jnp.int32(n))
+        admitted = self._aot_call("admit", admit_args)
+        if admitted is None:
+            admitted = self._admit_jit(*admit_args)
+            self._count_compile("admit")
+        self._cache_k, self._cache_v, self._positions = admitted
         first = int(next_token)
         self.positions[slot] = n
         self.active[slot] = True
@@ -262,11 +411,14 @@ class DecodeEngine:
         if self.device is not None:
             tokens = jax.device_put(tokens, self.device)
             active = jax.device_put(active, self.device)
+        step_args = (self._params, self._cache_k, self._cache_v,
+                     self._positions, active, tokens)
+        stepped = self._aot_call("step", step_args)
+        if stepped is None:
+            stepped = self._step_jit(*step_args)
+            self._count_compile("step")
         (self._cache_k, self._cache_v, self._positions,
-         next_tokens) = self._step_jit(
-            self._params, self._cache_k, self._cache_v,
-            self._positions, active, tokens)
-        self._count_compile("step")
+         next_tokens) = stepped
         out = np.asarray(next_tokens)
         self.positions[self.active] += 1
         self.tokens[self.active] = out[self.active]

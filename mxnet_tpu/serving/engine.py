@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError, getenv
+from ..compile import aot as _aot
+from ..compile.cache import enable_cache
 from ..graph import build_graph_fn, collect_vars, infer_structs
 from ..ndarray import NDArray
 from ..observability import registry as _obs
@@ -186,10 +188,14 @@ class InferenceEngine:
         # are never donated
         if donate is None:
             donate = getenv("MXTPU_SERVE_DONATE", True)
+        enable_cache()    # an engine freeze is a compile entry point
+        self._donate = bool(donate)
         self._jit = jax.jit(fwd, donate_argnums=(0,) if donate else ())
         self._lock = threading.Lock()
         self._compiled = set()      # (bucket, device-key) dispatched OK
         self._placed = {}           # device-key -> (params, aux) copies
+        self._aot = {}              # bucket -> deserialized executable
+        self._aot_device = None     # the device the executables target
 
     # ------------------------------------------------------------------
     # constructors
@@ -343,6 +349,122 @@ class InferenceEngine:
             self._placed = {}     # per-device copies are now stale
 
     # ------------------------------------------------------------------
+    # ahead-of-time executables (docs/compilation.md)
+    # ------------------------------------------------------------------
+    def _aot_abstract_args(self, bucket):
+        """The abstract (data, params, aux, key) trees one bucket's
+        forward program is lowered against — exactly what `infer`
+        passes, ShapeDtypeStruct'd."""
+        data = {name: jax.ShapeDtypeStruct((bucket,) + shape, dtype)
+                for name, shape, dtype in self._descs}
+        data.update((name, jax.ShapeDtypeStruct(shape, dtype))
+                    for name, (shape, dtype)
+                    in self._static_descs.items())
+        params = _aot.abstract(self._params)
+        phantoms = self._phantoms_for(bucket)
+        if phantoms:
+            params = {**params, **_aot.abstract(phantoms)}
+        aux = _aot.abstract(self._aux)
+        key = None
+        if self._needs_rng:
+            # current_key, NOT next_key: only the key's AVAL matters
+            # here, and splitting would silently advance the global
+            # stream on every export/load — a process that loaded a
+            # 7-bucket store would diverge from one on the JIT path
+            from .. import random as _random
+            key = _aot.abstract(_random.current_key())
+        return data, params, aux, key
+
+    def _aot_key_material(self, bucket):
+        data, params, aux, key = self._aot_abstract_args(bucket)
+        return {"kind": "infer_engine", "bucket": int(bucket),
+                "inputs": _aot.aval_signature(data),
+                "params": _aot.aval_signature(params),
+                "aux": _aot.aval_signature(aux),
+                "rng": _aot.aval_signature(key),
+                "dtype": self.dtype, "donate": self._donate}
+
+    def _aot_name(self, bucket):
+        return "engine/%s/b%d" % (self.name, bucket)
+
+    def aot_export(self, store, buckets=None, verify=True):
+        """Compile the padding-bucket forward programs ahead of time
+        (`jit.lower().compile()`) and serialize them into `store` —
+        the release-time half of the AOT path (`tools/aot_build.py`).
+        With `verify` (default), each blob is proven loadable in a
+        fresh interpreter and unprovable ones are pruned (an exporting
+        process that already ran the same program via a warm
+        persistent cache can emit symbol-referencing blobs only it
+        can read). Returns the list of (bucket, fingerprint) that
+        survived."""
+        if not isinstance(store, _aot.ArtifactStore):
+            store = _aot.ArtifactStore(store, create=True)
+        if buckets is None:
+            buckets = self._buckets if self._descs \
+                else (self.max_batch_size,)
+        out = []
+        for b in buckets:
+            b = self.bucket_for(b)
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                fp, _ = _aot.export_jit(
+                    store, self._aot_name(b), self._jit,
+                    self._aot_abstract_args(b),
+                    self._aot_key_material(b))
+            out.append((b, fp))
+        if verify and out:
+            ok = store.verify_and_prune(
+                [self._aot_name(b) for b, _ in out])
+            out = [(b, fp) for b, fp in out
+                   if ok.get(self._aot_name(b), True)]
+        return out
+
+    def aot_load(self, store, buckets=None):
+        """Load this engine's serialized executables from `store` into
+        the dispatch path: a loaded bucket's first request deserializes
+        nothing and compiles nothing. Any fingerprint mismatch, torn
+        blob, or replica-device mismatch falls back to JIT (counted in
+        `compile.aot.fallbacks`) — never a wrong-program load. Returns
+        the buckets loaded."""
+        if not isinstance(store, _aot.ArtifactStore):
+            store = _aot.ArtifactStore(store)
+        if buckets is None:
+            buckets = self._buckets if self._descs \
+                else (self.max_batch_size,)
+        default_dev = jax.local_devices()[0]
+        loaded = []
+        for b in buckets:
+            b = self.bucket_for(b)
+            fn = store.load_jit(self._aot_name(b),
+                                self._aot_key_material(b))
+            if fn is not None:
+                with self._lock:
+                    self._aot[b] = fn
+                    self._aot_device = default_dev
+                loaded.append(b)
+        if loaded:
+            store.hold(what="engine:%s" % self.name)
+        return loaded
+
+    def _aot_fn_for(self, bucket, device):
+        """The loaded executable serving (bucket, device), or None.
+        Executables are compiled for the default local device; a
+        replica pinned elsewhere keeps the JIT path (its programs are
+        cheap again thanks to the persistent cache)."""
+        if not self._aot:
+            return None
+        if device is not None and device != self._aot_device:
+            return None
+        return self._aot.get(bucket)
+
+    @property
+    def aot_buckets(self):
+        with self._lock:
+            return sorted(self._aot)
+
+    # ------------------------------------------------------------------
     # dispatch
     # ------------------------------------------------------------------
     def _phantoms_for(self, bucket, device=None):
@@ -488,12 +610,18 @@ class InferenceEngine:
         if n is None:
             n = rows
         bucket = self.bucket_for(rows)
-        data = {}
-        for d in self._descs:
-            data[d[0]] = self._pad(inputs[d[0]], d, bucket, device)
-        for name_, (shape, dtype) in self._static_descs.items():
-            data[name_] = self._stage_static(inputs[name_], name_,
-                                             shape, dtype, device)
+
+        def stage():
+            staged = {}
+            for d in self._descs:
+                staged[d[0]] = self._pad(inputs[d[0]], d, bucket,
+                                         device)
+            for nm, (shape, dtype) in self._static_descs.items():
+                staged[nm] = self._stage_static(inputs[nm], nm,
+                                                shape, dtype, device)
+            return staged
+
+        data = stage()
         compile_key = (bucket, None if device is None else device.id)
         with self._lock:
             compiling = compile_key not in self._compiled
@@ -505,7 +633,22 @@ class InferenceEngine:
         phantoms = self._phantoms_for(bucket, device)
         if phantoms:
             params = {**params, **phantoms}
-        if compiling:
+        outs = None
+        aot_fn = self._aot_fn_for(bucket, device)
+        if aot_fn is not None:
+            try:
+                # the AOT-loaded executable: no trace, no compile —
+                # first dispatch marks the bucket warm without touching
+                # the compile counter (nothing compiled)
+                outs = aot_fn(data, params, aux, key)
+                with self._lock:
+                    self._compiled.add(compile_key)
+            except Exception:  # noqa: BLE001 — any failure = JIT path
+                with self._lock:
+                    self._aot.pop(bucket, None)
+                _aot.FALLBACKS.inc(reason="dispatch")
+                data = stage()   # the failed call may have donated it
+        if outs is None and compiling:
             # a forward-only program often can't alias the donated
             # request buffer into its outputs; that's fine (donation
             # still frees it for intermediates) — silence XLA's
@@ -522,7 +665,7 @@ class InferenceEngine:
                 if compile_key not in self._compiled:
                     self._compiled.add(compile_key)
                     _COMPILES.inc(engine=self.name, bucket=str(bucket))
-        else:
+        elif outs is None:
             outs = self._jit(data, params, aux, key)
         keep = None if n == bucket else n
         result = [NDArray(o[:keep] if keep is not None else o)

@@ -30,6 +30,8 @@ from contextlib import contextmanager
 import numpy as np
 
 from ..base import MXNetError, getenv
+from ..compile import aot as _aot
+from ..compile import coldstart as _coldstart
 from ..observability import registry as _obs
 from ..observability import telemetry as _telemetry
 from ..resilience import chaos_point
@@ -107,7 +109,13 @@ class ModelServer:
 
     def __init__(self, engine, num_workers=None, max_batch_size=None,
                  max_wait_ms=None, queue_depth=None, shed_policy=None,
-                 warmup=False, max_new_tokens=None):
+                 warmup=False, max_new_tokens=None, artifacts=None):
+        # artifacts: an ArtifactStore (or its path) of AOT-serialized
+        # executables loaded BEFORE warmup/first dispatch, so a rollout
+        # restart stops paying compile (docs/compilation.md). Default:
+        # the MXTPU_AOT_STORE store when set.
+        self._artifacts = artifacts
+        self._aot_loaded = []
         if isinstance(engine, DecodeEngine):
             # second engine kind: continuous-batching autoregressive
             # decode — one ContinuousBatchScheduler per device replica,
@@ -212,8 +220,39 @@ class ModelServer:
             self._release_lease()
             raise
 
+    def _load_artifacts(self):
+        """Deserialize AOT executables into the engines before any
+        dispatch. Mismatches degrade to JIT per program (counted, never
+        raised); returns the list of loaded program keys."""
+        store = self._artifacts
+        if store is None:
+            store = _aot.default_store()
+        if store is None:
+            return []
+        if not isinstance(store, _aot.ArtifactStore):
+            store = _aot.ArtifactStore(store)
+        if self.kind == "decode":
+            # only the default-device engine can host the executables;
+            # pinned replicas keep the (persistent-cache-warm) JIT path
+            loaded = []
+            for s in self._schedulers:
+                if s.engine.device is None:
+                    loaded.extend(s.engine.aot_load(store))
+            return loaded
+        return ["b%d" % b for b in self.engine.aot_load(store)]
+
+    def _mark_ready(self):
+        """Publish the process cold-start record (boot -> serving):
+        the serving-side ready marker for telemetry_report's compile
+        section, perf_gate --max-cold-start-s, and the gang report's
+        downtime split."""
+        _coldstart.mark_ready(
+            "serving", engine=self.engine.name, kind=self.kind,
+            aot_programs=len(self._aot_loaded))
+
     def _start(self):
         if self.kind == "decode":
+            self._aot_loaded = self._load_artifacts()
             if self._warmup:
                 for s in self._schedulers:
                     s.engine.warmup()
@@ -228,7 +267,9 @@ class ModelServer:
                 target=self._decode_signal_watch, daemon=True,
                 name="decode-signal-watch")
             self._signal_watcher.start()
+            self._mark_ready()
             return self
+        self._aot_loaded = self._load_artifacts()
         if self._warmup:
             # warm every replica device the workers dispatch on, not
             # just the default one
@@ -238,6 +279,7 @@ class ModelServer:
         for w in self._workers:
             w.thread.start()
         self._dispatcher.start()
+        self._mark_ready()
         return self
 
     def __enter__(self):
@@ -472,6 +514,7 @@ class ModelServer:
                 "dtype": self.engine.dtype,
                 "max_slots": self.engine.max_slots,
                 "max_seq_len": self.engine.max_seq_len,
+                "aot_programs": self.engine.aot_programs,
                 "workers": per,
                 "submitted": sum(p["submitted"] for p in per),
                 "served": sum(p["served"] for p in per),
@@ -500,6 +543,7 @@ class ModelServer:
             "engine": self.engine.name,
             "buckets": list(self.engine.buckets),
             "compiled_buckets": self.engine.compiled_buckets,
+            "aot_buckets": self.engine.aot_buckets,
             "max_batch_size": self.batcher.max_batch_size,
             "max_wait_ms": self.batcher.max_wait_s * 1000.0,
             "queue_depth": len(self.batcher),

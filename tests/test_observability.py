@@ -261,6 +261,11 @@ def _run_gluon_steps(n_steps, batch_size=8):
 
 def test_gluon_5step_jsonl_and_report(tmp_path, monkeypatch):
     out = tmp_path / "telemetry.jsonl"
+    # consume the once-per-process cold-start marker BEFORE the stream
+    # opens: run solo, the first trainer step would otherwise publish
+    # its source="compile" record into this strict 5-line assertion
+    from mxnet_tpu.compile import coldstart
+    coldstart.mark_ready("test-setup")
     monkeypatch.setenv("MXTPU_TELEMETRY", str(out))
     _run_gluon_steps(5)
     close_stream()
@@ -274,8 +279,11 @@ def test_gluon_5step_jsonl_and_report(tmp_path, monkeypatch):
         assert rec["kvstore_bytes"] > 0      # grads pushed through kvstore
         assert rec["batch_size"] == 8
     assert [r["step"] for r in lines] == list(range(5))
-    # warm-up XLA compiles are visible and attributed to early steps
-    assert sum(r["compile_count"] for r in lines) > 0
+    # warm-up XLA compiles are visible and attributed to early steps —
+    # with a warm persistent compilation cache (tests/conftest.py) the
+    # backend never compiles, and the cache-hit delta says why
+    assert sum(r["compile_count"] + r.get("compile_cache_hits", 0)
+               for r in lines) > 0
     # data_wait was measured on the consumer side of NDArrayIter
     assert sum(r["data_wait"] for r in lines) > 0
 
@@ -299,6 +307,8 @@ def test_gluon_5step_jsonl_and_report(tmp_path, monkeypatch):
 
 def test_module_fit_emits_step_records(tmp_path, monkeypatch):
     out = tmp_path / "module.jsonl"
+    from mxnet_tpu.compile import coldstart
+    coldstart.mark_ready("test-setup")   # see 5-step test above
     monkeypatch.setenv("MXTPU_TELEMETRY", str(out))
     rng = np.random.RandomState(7)
     x = rng.randn(40, 8).astype(np.float32)

@@ -416,8 +416,13 @@ def test_server_telemetry_records(tmp_path):
     finally:
         del os.environ["MXTPU_TELEMETRY"]
         telemetry.close_stream()
-    recs = [json.loads(l) for l in open(path) if l.strip()]
-    assert recs and all(r["source"] == "serving" for r in recs)
+    allrecs = [json.loads(l) for l in open(path) if l.strip()]
+    # the stream is shared: the process's one-off cold-start record
+    # (source="compile", docs/compilation.md) may ride along with the
+    # per-batch serving records under test
+    recs = [r for r in allrecs if r["source"] == "serving"]
+    assert recs
+    assert all(r["source"] in ("serving", "compile") for r in allrecs)
     assert all("step_time" in r and "fill_ratio" in r for r in recs)
     assert sum(r["requests"] for r in recs) == 5
 

@@ -27,6 +27,11 @@ is rejected):
                           FAIL the gate instead of posting a fake
                           throughput number (docs/fault_tolerance.md)
     --max-anomalies       same, over the anomaly count (skips + spikes)
+    --max-cold-start-s    worst process boot -> first-useful-dispatch
+                          time across the stream's cold-start records
+                          (source="compile"; docs/compilation.md) — a
+                          rollout/restart that re-pays full compile
+                          must fail the gate, not ship
     --min-steps           refuse a stream shorter than this (default 1
                           — a truncated run must not "pass")
 
@@ -84,6 +89,7 @@ def evaluate(summary, args):
                        frac is not None and frac <= args.max_data_wait_frac))
     check("skipped_steps", "skipped_steps", args.max_skipped_steps, le)
     check("anomalies", "anomalies", args.max_anomalies, le)
+    check("cold_start_s", "cold_start_max_s", args.max_cold_start_s, le)
     check("steps", "steps", args.min_steps, ge)
     return checks
 
@@ -102,6 +108,7 @@ def main(argv=None):
     ap.add_argument("--max-data-wait-frac", type=float, default=None)
     ap.add_argument("--max-skipped-steps", type=float, default=None)
     ap.add_argument("--max-anomalies", type=float, default=None)
+    ap.add_argument("--max-cold-start-s", type=float, default=None)
     ap.add_argument("--min-steps", type=float, default=1)
     args = ap.parse_args(argv)
 
@@ -109,7 +116,7 @@ def main(argv=None):
                args.max_step_mean_s, args.max_compile_stall_s,
                args.max_compiles, args.min_samples_per_sec,
                args.max_data_wait_frac, args.max_skipped_steps,
-               args.max_anomalies)
+               args.max_anomalies, args.max_cold_start_s)
     verdict = {"path": args.path, "ok": False, "breaches": []}
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"

@@ -43,11 +43,27 @@ eviction rate:
                "ttft_p50_ms": .., "intertoken_p50_ms": ..,
                "eviction_rate": .., "parity": true}}
 
+`--mode coldstart` benches COLD START instead (ISSUE-11): two fresh
+child processes serve one request each through the full boot path
+(import → freeze → artifact load → warmup → first response), timed
+from the kernel's record of process start. The first child runs
+against empty cache/artifact directories and populates them (persistent
+XLA cache + AOT-exported executables); the second starts warm. The
+record is the before/after of docs/compilation.md (acceptance: warm
+>= 2x cold on CPU):
+
+    {"metric": "serving_cold_start_speedup", "value": .., "unit": "x",
+     "extra": {"cold_start_s": .., "warm_start_s": .., "speedup": ..,
+               "cold": {cache/aot counters}, "warm": {...}}}
+
 Env knobs (flags win): MXTPU_SERVE_BENCH_CLIENTS (16),
 MXTPU_SERVE_BENCH_REQUESTS (640 total), MXTPU_SERVE_BENCH_SERIAL (160),
 MXTPU_SERVE_BENCH_FEATURES (256), MXTPU_SERVE_BENCH_HIDDEN (256),
 MXTPU_SERVE_BENCH_RATE (open-loop offered req/s, 2000),
 MXTPU_SERVE_BENCH_QUEUE (open-loop queue depth, 64).
+Coldstart knobs: MXTPU_SERVE_BENCH_COLD_DEPTH (56 FC layers),
+MXTPU_SERVE_BENCH_COLD_HIDDEN (192), MXTPU_SERVE_BENCH_COLD_BATCH (64
+max batch -> 7 padding buckets).
 Decode knobs: MXTPU_SERVE_BENCH_DECODE_SEQS (24 prompts),
 MXTPU_SERVE_BENCH_DECODE_SLOTS (8 cache slots),
 MXTPU_SERVE_BENCH_DECODE_NEW (16 tokens/request),
@@ -72,23 +88,33 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-def _build_model(features, hidden, classes=16, seed=7):
+def _build_model(features, hidden, classes=16, seed=7, depth=3):
+    """The bench MLP: `depth` FullyConnected layers (depth-1 hidden +
+    one `classes` head; depth=3 reproduces the original fc1/fc2/fc3
+    shape exactly). Coldstart mode raises `depth` so compile time — the
+    quantity under test — dominates process boot."""
     import mxnet_tpu as mx
-    data = mx.sym.var("data")
-    h = mx.sym.FullyConnected(data=data, num_hidden=hidden, name="fc1")
-    h = mx.sym.Activation(data=h, act_type="relu")
-    h = mx.sym.FullyConnected(data=h, num_hidden=hidden, name="fc2")
-    h = mx.sym.Activation(data=h, act_type="relu")
-    h = mx.sym.FullyConnected(data=h, num_hidden=classes, name="fc3")
-    sym = mx.sym.SoftmaxOutput(data=h, name="softmax")
+    depth = max(2, int(depth))
     rng = np.random.RandomState(seed)
 
     def p(*shape):
         return mx.nd.array((rng.randn(*shape) * 0.1).astype(np.float32))
 
-    args = {"fc1_weight": p(hidden, features), "fc1_bias": p(hidden),
-            "fc2_weight": p(hidden, hidden), "fc2_bias": p(hidden),
-            "fc3_weight": p(classes, hidden), "fc3_bias": p(classes)}
+    h = mx.sym.var("data")
+    args = {}
+    in_dim = features
+    for i in range(1, depth):
+        name = "fc%d" % i
+        h = mx.sym.FullyConnected(data=h, num_hidden=hidden, name=name)
+        h = mx.sym.Activation(data=h, act_type="relu")
+        args[name + "_weight"] = p(hidden, in_dim)
+        args[name + "_bias"] = p(hidden)
+        in_dim = hidden
+    name = "fc%d" % depth
+    h = mx.sym.FullyConnected(data=h, num_hidden=classes, name=name)
+    args[name + "_weight"] = p(classes, in_dim)
+    args[name + "_bias"] = p(classes)
+    sym = mx.sym.SoftmaxOutput(data=h, name="softmax")
     return sym, args
 
 
@@ -294,11 +320,120 @@ def run_decode(args_ns):
     }
 
 
+def run_coldstart_child(args_ns):
+    """One fresh serving process: boot -> engine freeze -> artifact
+    load -> warmup -> first response, timed from the kernel's record
+    of process start (so interpreter+import cost is inside the
+    window). Emits one JSON line; with --coldstart-export, exports the
+    engine's AOT program set afterwards (outside the timed window) so
+    the next child starts warm."""
+    import time
+    import mxnet_tpu  # noqa: F401 — the heavy import, on the clock
+    from mxnet_tpu.compile import cache, coldstart
+    from mxnet_tpu.observability import registry as _obs
+    from mxnet_tpu.serving import InferenceEngine, ModelServer
+
+    sym, params = _build_model(args_ns.features, args_ns.hidden,
+                               depth=args_ns.depth)
+    engine = InferenceEngine.from_symbol(
+        sym, params, {}, {"data": (args_ns.features,)},
+        max_batch_size=args_ns.max_batch, name="coldstart")
+    server = ModelServer(engine, num_workers=1, warmup=True).start()
+    x = np.zeros((1, args_ns.features), np.float32)
+    server.infer(x, timeout=300)
+    first_response_s = time.time() - coldstart.process_start_time()
+    stats = server.stats()
+    ready = coldstart.cold_record() or {}
+    if args_ns.coldstart_export:
+        store = os.environ.get("MXTPU_AOT_STORE")
+        if store:
+            engine.aot_export(store)
+    server.drain(timeout=60)
+
+    def total(name):
+        m = _obs.REGISTRY.get(name)
+        return m.total() if m is not None else 0
+
+    print(json.dumps({
+        "cold_start_s": round(first_response_s, 4),
+        "ready_s": round(ready.get("step_time", first_response_s), 4),
+        "compile_count": int(total("xla.compile.count")),
+        "compile_seconds": round(float(total("xla.compile.seconds")),
+                                 4),
+        "cache_hits": int(total("compile.cache.hits")),
+        "cache_misses": int(total("compile.cache.misses")),
+        "aot_loads": int(total("compile.aot.loads")),
+        "aot_fallbacks": int(total("compile.aot.fallbacks")),
+        "aot_buckets": stats.get("aot_buckets", []),
+        "cache_entries": cache.cache_stats()["entries"],
+    }))
+    return 0
+
+
+def run_coldstart(args_ns):
+    """Cold vs warm artifact store, each in a FRESH process (ISSUE 11
+    acceptance: warm >= 2x cold on CPU): the cold child boots against
+    empty cache/store directories and populates them (persistent cache
+    as a side effect of compiling, AOT store via --coldstart-export);
+    the warm child boots against the populated directories."""
+    import shutil
+    import subprocess
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="mxtpu_coldstart_")
+    env = dict(os.environ)
+    env.update(MXTPU_COMPILE_CACHE=os.path.join(workdir, "xla_cache"),
+               MXTPU_AOT_STORE=os.path.join(workdir, "aot"),
+               MXTPU_COMPILE_CACHE_MIN_S="0")
+    # an outer cache (tests/conftest.py's session dir) must not leak
+    # into the cold child — cold means cold
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    base = [sys.executable, os.path.abspath(__file__),
+            "--coldstart-child",
+            "--features", str(args_ns.features),
+            "--hidden", str(args_ns.cold_hidden),
+            "--depth", str(args_ns.depth),
+            "--max-batch", str(args_ns.max_batch)]
+
+    def child(extra):
+        r = subprocess.run(base + extra, env=env, timeout=900,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError("coldstart child failed:\n%s\n%s"
+                               % (r.stdout[-2000:], r.stderr[-2000:]))
+        return json.loads([ln for ln in r.stdout.splitlines()
+                           if ln.startswith("{")][-1])
+
+    try:
+        cold = child(["--coldstart-export"])
+        warm = child([])
+    finally:
+        # the populated cache + store are per-run scratch (tens of MB
+        # at the full shapes) — never leave them accumulating in /tmp
+        shutil.rmtree(workdir, ignore_errors=True)
+    speedup = (cold["cold_start_s"] / warm["cold_start_s"]
+               if warm["cold_start_s"] > 0 else 0.0)
+    return {
+        "metric": "serving_cold_start_speedup",
+        "value": round(speedup, 3), "unit": "x",
+        "extra": {
+            "cold_start_s": cold["cold_start_s"],
+            "warm_start_s": warm["cold_start_s"],
+            "speedup": round(speedup, 3),
+            "features": args_ns.features,
+            "hidden": args_ns.cold_hidden,
+            "depth": args_ns.depth, "max_batch": args_ns.max_batch,
+            "cold": cold, "warm": warm,
+        },
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="serving load generator (closed/open/decode)")
+        description="serving load generator "
+                    "(closed/open/decode/coldstart)")
     parser.add_argument("--mode",
-                        choices=("closed", "open", "both", "decode"),
+                        choices=("closed", "open", "both", "decode",
+                                 "coldstart"),
                         default="closed")
     parser.add_argument("--clients", type=int,
                         default=_env_int("MXTPU_SERVE_BENCH_CLIENTS", 16))
@@ -314,9 +449,31 @@ def main(argv=None):
                         default=_env_int("MXTPU_SERVE_BENCH_RATE", 2000))
     parser.add_argument("--open-queue", type=int,
                         default=_env_int("MXTPU_SERVE_BENCH_QUEUE", 64))
+    parser.add_argument("--depth", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_COLD_DEPTH",
+                                         56))
+    parser.add_argument("--cold-hidden", type=int,
+                        default=_env_int(
+                            "MXTPU_SERVE_BENCH_COLD_HIDDEN", 192))
+    parser.add_argument("--max-batch", type=int,
+                        default=_env_int("MXTPU_SERVE_BENCH_COLD_BATCH",
+                                         64))
+    parser.add_argument("--coldstart-child", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--coldstart-export", action="store_true",
+                        help=argparse.SUPPRESS)
     args_ns = parser.parse_args(argv)
 
+    if args_ns.coldstart_child:
+        return run_coldstart_child(args_ns)
+
     import jax
+
+    if args_ns.mode == "coldstart":
+        record = run_coldstart(args_ns)
+        record["platform"] = jax.default_backend()
+        print(json.dumps(record))
+        return 0
 
     if args_ns.mode == "decode":
         record = run_decode(args_ns)

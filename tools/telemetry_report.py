@@ -75,7 +75,7 @@ def summarize(records):
     # (a serving-only file keeps its records)
     core = [r for r in records
             if not str(r.get("source", "")).startswith(
-                ("serving", "decode", "resilience"))] \
+                ("serving", "decode", "resilience", "compile"))] \
         or records
     step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
@@ -228,6 +228,40 @@ def summarize(records):
         # budget must read 0, not "metric absent", on a clean stream
         summary["skipped_steps"] = 0
         summary["anomalies"] = 0
+    # compile / cold-start section (docs/compilation.md): one
+    # source="compile", event="cold_start" record per process
+    # (step_time = process boot -> first useful dispatch), plus
+    # per-step persistent-cache hit/miss deltas on training records
+    cold = [r for r in records if r.get("source") == "compile"
+            and r.get("event") == "cold_start"]
+    # hits/misses come from ONE source: step records carry per-step
+    # DELTAS (their sum is the run total), the cold-start record
+    # carries the process-CUMULATIVE totals at first dispatch — adding
+    # both would double-count every warm-up hit. Prefer the step
+    # deltas when any step carried them (training streams); fall back
+    # to the cold-start totals (serving streams emit no step deltas).
+    step_hits = sum(int(r.get("compile_cache_hits", 0)) for r in core)
+    step_misses = sum(int(r.get("compile_cache_misses", 0))
+                      for r in core)
+    if step_hits or step_misses:
+        cache_hits, cache_misses = step_hits, step_misses
+    else:
+        cache_hits = sum(int(r.get("cache_hits", 0)) for r in cold)
+        cache_misses = sum(int(r.get("cache_misses", 0)) for r in cold)
+    if cold or cache_hits or cache_misses:
+        summary["compile_cache_hits"] = cache_hits
+        summary["compile_cache_misses"] = cache_misses
+    if cold:
+        cs = sorted(float(r["step_time"]) for r in cold)
+        summary["cold_starts"] = len(cs)
+        summary["cold_start_p50_s"] = _percentile(cs, 0.50)
+        summary["cold_start_max_s"] = cs[-1]
+        summary["cold_start_compile_s"] = sum(
+            float(r.get("compile_seconds", 0.0)) for r in cold)
+        summary["aot_loads"] = sum(int(r.get("aot_loads", 0))
+                                   for r in cold)
+        summary["aot_fallbacks"] = sum(int(r.get("aot_fallbacks", 0))
+                                       for r in cold)
     # lease/watchdog section (docs/fault_tolerance.md): DeviceLease and
     # HealthWatchdog emit source="resilience" events — step_time is the
     # event's duration (acquire wait, takeover time, tripped budget)
@@ -375,6 +409,18 @@ def format_summary(s):
         if "loss_scale_last" in s:
             lines.append("              loss scale %g"
                          % s["loss_scale_last"])
+    if "cold_starts" in s or "compile_cache_hits" in s:
+        if "compile_cache_hits" in s:
+            lines.append(
+                "  compile     cache hits %d  misses %d"
+                % (s["compile_cache_hits"], s["compile_cache_misses"]))
+        if s.get("cold_starts"):
+            lines.append(
+                "  cold start  %d process(es)  p50 %.3fs  max %.3fs  "
+                "compile %.3fs  aot loads %d  fallbacks %d"
+                % (s["cold_starts"], s["cold_start_p50_s"],
+                   s["cold_start_max_s"], s["cold_start_compile_s"],
+                   s.get("aot_loads", 0), s.get("aot_fallbacks", 0)))
     if "lease_acquires" in s or "watchdog_trips" in s:
         lines.append(
             "  lease       %d acquires (p95 %.4fs)  %d takeovers%s"
