@@ -42,7 +42,10 @@ step boundary — `resilience.preempt.at_step_boundary` — so `kind=kill`
 kills a rank mid-run), `engine.host_push`, `serving.infer`,
 `serving.decode` (fires before every continuous-batching decode step;
 kind=sleep stretches steps so deadline eviction can be exercised,
-kind=raise fails every in-flight sequence), `lease.acquire` (before a
+kind=raise fails every in-flight sequence), `gateway.admit` (on every
+gateway admission attempt, before the priority queues — a tripped
+fault is one 500 response, the gateway keeps serving), `lease.acquire`
+(before a
 `DeviceLease.acquire` touches the lease file), `device.init`
 (before `HealthWatchdog.init_devices` probes the backend — kind=sleep
 exercises the init deadline), and the array-corruption sites

@@ -35,11 +35,19 @@ Generation is the second engine kind (ISSUE-6):
 `ModelServer` serves either kind (per-device replicas, least-loaded
 dispatch, graceful drain).
 
+The front door sits on top (ISSUE-12):
+
+- `gateway`: `ModelRegistry` (N models per process under a measured
+             HBM/host budget, LRU eviction with graceful drain,
+             single-flight transparent reload) + `Gateway` (threaded
+             stdlib HTTP server with interactive|batch|best_effort
+             priority-class admission and deadline-aware shedding).
+
 `c_predict.Predictor` and `Module.predict` are thin shims over this
 layer (``MXTPU_SERVING_ENGINE=0`` restores the legacy Module path).
-Chaos sites: `serving.infer`, `serving.decode`. Metrics: `serving.*`
-in the observability registry; per-batch/per-step JSONL records ride
-the ``MXTPU_TELEMETRY`` stream.
+Chaos sites: `serving.infer`, `serving.decode`, `gateway.admit`.
+Metrics: `serving.*` in the observability registry; per-batch/per-step
+JSONL records ride the ``MXTPU_TELEMETRY`` stream.
 """
 from .engine import InferenceEngine, bucket_sizes, resolve_serve_dtype
 from .batcher import (DynamicBatcher, InferenceRequest, RequestRejected,
@@ -47,8 +55,10 @@ from .batcher import (DynamicBatcher, InferenceRequest, RequestRejected,
 from .decode import DecodeEngine
 from .scheduler import ContinuousBatchScheduler, DecodeRequest
 from .server import ModelServer
+from .gateway import Gateway, ModelRegistry, PRIORITY_CLASSES
 
 __all__ = ["InferenceEngine", "bucket_sizes", "resolve_serve_dtype",
            "DynamicBatcher", "InferenceRequest", "RequestRejected",
            "ServerClosed", "DecodeEngine", "ContinuousBatchScheduler",
-           "DecodeRequest", "ModelServer"]
+           "DecodeRequest", "ModelServer", "Gateway", "ModelRegistry",
+           "PRIORITY_CLASSES"]

@@ -67,7 +67,15 @@ class RequestRejected(MXNetError):
 
 
 class ServerClosed(RequestRejected):
-    """The batcher/server is closed or draining; no new work accepted."""
+    """The batcher/server is closed or draining; no new work accepted.
+
+    `server` names the refusing server/engine when known — a
+    multiplexed gateway fronting N models must attribute a drain-time
+    503 to the model being evicted, not guess from a bare message."""
+
+    def __init__(self, msg, server=None):
+        super().__init__(msg)
+        self.server = server
 
 
 class InferenceRequest:
@@ -199,7 +207,8 @@ class DynamicBatcher:
                 while self._queue:
                     req = self._queue.popleft()
                     req.reject(ServerClosed(
-                        "server closed before the request was served"))
+                        "server %r closed before the request was "
+                        "served" % self.name, server=self.name))
                 _QUEUE_DEPTH.set(0)
             self._cond.notify_all()
 
@@ -223,7 +232,9 @@ class DynamicBatcher:
                                source=self.name)
         with self._cond:
             if self._closed:
-                raise ServerClosed("server is draining; request refused")
+                raise ServerClosed(
+                    "server %r is draining; request refused" % self.name,
+                    server=self.name)
             if len(self._queue) >= self.queue_depth:
                 if self.shed_policy == "reject":
                     self.shed += 1

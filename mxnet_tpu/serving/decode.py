@@ -204,6 +204,18 @@ class DecodeEngine:
         kind = key[0] if isinstance(key, tuple) else key
         _COMPILES.inc(engine=self.name, kind=kind)
 
+    def device_bytes(self):
+        """Measured device-buffer bytes this engine keeps resident:
+        params plus the statically-shaped KV cache and position vector
+        — the number a model-multiplexing registry accounts against
+        its HBM/host budget. The cache dominates at scale: it is
+        allocated for max_slots whether or not any sequence is
+        active."""
+        total = sum(int(v.nbytes) for v in self._params.values())
+        total += int(self._cache_k.nbytes) + int(self._cache_v.nbytes)
+        total += int(self._positions.nbytes)
+        return total
+
     def bucket_for(self, n):
         """Smallest prefill padding bucket holding an n-token prompt."""
         n = int(n)

@@ -314,6 +314,22 @@ class InferenceEngine:
         with self._lock:
             return sorted({b for b, _ in self._compiled})
 
+    def device_bytes(self):
+        """Measured device-buffer bytes this engine keeps resident:
+        params + aux on the default device plus every per-replica
+        placed copy — the number a model-multiplexing registry accounts
+        against its HBM/host budget (docs/serving.md "Front door &
+        multiplexing"). Request/activation buffers are step-local
+        (donated) and not counted."""
+        total = sum(int(v.nbytes) for v in self._params.values())
+        total += sum(int(v.nbytes) for v in self._aux.values())
+        with self._lock:
+            placed = list(self._placed.values())
+        for params, aux in placed:
+            total += sum(int(v.nbytes) for v in params.values())
+            total += sum(int(v.nbytes) for v in aux.values())
+        return total
+
     def bucket_for(self, n):
         """Smallest padding bucket that holds `n` rows."""
         n = int(n)

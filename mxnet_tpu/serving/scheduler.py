@@ -251,7 +251,8 @@ class ContinuousBatchScheduler:
         with self._cond:
             if self._closed:
                 raise ServerClosed(
-                    "scheduler is draining; request refused")
+                    "scheduler %r is draining; request refused"
+                    % self.name, server=self.name)
             if len(self._queue) >= self.queue_depth:
                 if self.shed_policy == "reject":
                     self.shed += 1
@@ -335,8 +336,9 @@ class ContinuousBatchScheduler:
             for req in leftovers:
                 if not req.done():
                     req.reject(ServerClosed(
-                        "decode scheduler stopped before the request "
-                        "finished"))
+                        "decode scheduler %r stopped before the "
+                        "request finished" % self.name,
+                        server=self.name))
             self._stopped.set()
 
     def _pop_live(self):

@@ -396,7 +396,9 @@ class ModelServer:
         if not self._started:
             raise MXNetError("ModelServer.submit before start()")
         if self.draining:
-            raise ServerClosed("server is draining; request refused")
+            raise ServerClosed(
+                "server %r is draining; request refused"
+                % self.engine.name, server=self.engine.name)
         if self.kind == "decode":
             sched = min(self._schedulers, key=lambda s: s.load())
             return sched.submit(inputs, deadline=deadline,
@@ -500,6 +502,15 @@ class ModelServer:
                 "shed_total": self.batcher.shed,
                 "worker": worker.index,
             })
+
+    def device_bytes(self):
+        """Measured device-buffer bytes across this server's engines
+        (per-replica decode engines each carry their own cache) — the
+        gateway registry's HBM-budget accounting input."""
+        if self.kind == "decode":
+            return sum(s.engine.device_bytes()
+                       for s in self._schedulers)
+        return self.engine.device_bytes()
 
     # ------------------------------------------------------------------
     # observability

@@ -1,0 +1,648 @@
+"""Serving front door & model multiplexing (ISSUE 12, docs/serving.md).
+
+Covers the acceptance surface end to end: three models multiplexed in
+one process under a budget that only fits two — LRU eviction and
+transparent single-flight reload observed over REAL HTTP, responses
+byte-identical to direct `ModelServer.infer`/`generate`; in-flight
+requests on an evicted model finish token-identically; priority-class
+admission grants interactive before batch before best_effort and sheds
+expired-in-queue requests before compute; `/readyz` flips only after
+every eager engine's warmup; `ServerClosed` names the draining server;
+the `gateway.admit` chaos site fails one request, not the server; and
+`tools/kill_stale.py` recognizes a gateway-role lease holder.
+"""
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import Deadline, DeadlineExceeded, chaos
+from mxnet_tpu.resilience.lease import _proc_starttime
+from mxnet_tpu.serving import (DecodeEngine, Gateway, InferenceEngine,
+                               ModelRegistry, ModelServer,
+                               RequestRejected, ServerClosed)
+from mxnet_tpu.serving.gateway.frontdoor import _Admission
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FEATURES, CLASSES = 8, 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.configure("")
+    yield
+    chaos.reset()
+
+
+def _mlp_engine(seed, name=None, max_batch=2):
+    """Tiny frozen MLP; every seed shares one program set (same
+    shapes), different weights — a response routed to the wrong model
+    cannot pass the byte-identity checks."""
+    rng = np.random.RandomState(seed)
+    h = mx.sym.FullyConnected(data=mx.sym.var("data"),
+                              num_hidden=CLASSES, name="fc1")
+    sym = mx.sym.SoftmaxOutput(data=h, name="softmax")
+    args = {"fc1_weight": mx.nd.array(
+                (rng.randn(CLASSES, FEATURES) * 0.5).astype(np.float32)),
+            "fc1_bias": mx.nd.array(
+                rng.randn(CLASSES).astype(np.float32))}
+    return InferenceEngine.from_symbol(
+        sym, args, {}, {"data": (FEATURES,)}, max_batch,
+        name=name or ("m%d" % seed))
+
+
+def _mlp_builder(seed, name=None):
+    return lambda: _mlp_engine(seed, name=name)
+
+
+def _gpt_block(seed=3, vocab=32, max_seq_len=16):
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTDecoder
+    np.random.seed(seed)
+    blk = GPTDecoder(vocab, max_seq_len=max_seq_len, num_layers=1,
+                     num_heads=2, embed_dim=16)
+    blk.initialize(mx.init.Xavier(magnitude=2.5))
+    return blk
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode("utf-8"))
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode("utf-8"))
+
+
+# -- ServerClosed attribution (the PR-12 bugfix) --------------------------
+
+def test_server_closed_names_the_draining_server():
+    server = ModelServer(_mlp_engine(0, name="attrib"), num_workers=1,
+                         max_wait_ms=1.0).start()
+    assert server.drain(timeout=30)
+    with pytest.raises(ServerClosed) as err:
+        server.submit(np.zeros((1, FEATURES), np.float32))
+    assert err.value.server == "attrib"
+    assert "attrib" in str(err.value)
+
+
+def test_batcher_and_scheduler_closed_errors_carry_the_name():
+    from mxnet_tpu.serving import (ContinuousBatchScheduler,
+                                   DynamicBatcher)
+    b = DynamicBatcher(["data"], name="named_batcher")
+    b.close()
+    with pytest.raises(ServerClosed) as err:
+        b.submit(np.zeros((1, FEATURES), np.float32))
+    assert err.value.server == "named_batcher"
+    sched = ContinuousBatchScheduler(
+        DecodeEngine(_gpt_block(), max_slots=1, name="named_decode"),
+        name="named_decode")
+    sched.close()
+    with pytest.raises(ServerClosed) as err:
+        sched.submit([1, 2])
+    assert err.value.server == "named_decode"
+
+
+# -- accounting -----------------------------------------------------------
+
+def test_device_bytes_measures_params_and_kv_cache():
+    eng = _mlp_engine(1)
+    expect = (CLASSES * FEATURES + CLASSES) * 4
+    assert eng.device_bytes() == expect
+    dec = DecodeEngine(_gpt_block(), max_slots=2)
+    n = dec.device_bytes()
+    assert n > int(dec._cache_k.nbytes) + int(dec._cache_v.nbytes) > 0
+    server = ModelServer(eng, num_workers=1)
+    assert server.device_bytes() == expect
+
+
+# -- ModelRegistry --------------------------------------------------------
+
+def test_registry_lru_eviction_and_transparent_reload():
+    reg = ModelRegistry()
+    for i in range(3):
+        reg.register("m%d" % i, _mlp_builder(i), num_workers=1,
+                     max_wait_ms=1.0)
+    x = np.ones((1, FEATURES), np.float32)
+    out0 = np.asarray(reg.get("m0").infer(x, timeout=30)[0])
+    reg.get("m1").infer(x, timeout=30)
+    per = reg.stats()["models"]["m0"]["bytes"]
+    assert per > 0
+    # budget fits two models; m0 is the coldest after touching m1
+    reg.set_budget(budget_bytes=int(2.5 * per))
+    assert reg.resident() == ["m0", "m1"]
+    reg.get("m2").infer(x, timeout=30)
+    assert reg.resident() == ["m1", "m2"]
+    # transparent reload of the evicted model, counted, same answer
+    assert reg.stats()["reloads"] == 0
+    out0b = np.asarray(reg.get("m0").infer(x, timeout=30)[0])
+    assert np.array_equal(out0, out0b)
+    assert reg.stats()["reloads"] == 1
+    assert reg.resident() == ["m0", "m2"]    # m1 was coldest
+    assert reg.drain_all(timeout=30)
+
+
+def test_registry_max_models_budget_and_unknown_name():
+    reg = ModelRegistry(max_models=1)
+    reg.register("a", _mlp_builder(0), num_workers=1)
+    reg.register("b", _mlp_builder(1), num_workers=1)
+    reg.get("a")
+    reg.get("b")
+    assert reg.resident() == ["b"]
+    with pytest.raises(mx.base.MXNetError, match="unknown model"):
+        reg.get("nope")
+    with pytest.raises(mx.base.MXNetError, match="already registered"):
+        reg.register("a", _mlp_builder(0))
+    with pytest.raises(mx.base.MXNetError, match="name"):
+        reg.register("bad:name", _mlp_builder(0))
+    assert reg.drain_all(timeout=30)
+
+
+def test_registry_single_flight_reload():
+    """Concurrent requests for the same cold model trigger exactly ONE
+    build; the rest wait on it and share the server."""
+    calls = []
+
+    def slow_builder():
+        calls.append(1)
+        time.sleep(0.2)
+        return _mlp_engine(5, name="single")
+
+    reg = ModelRegistry()
+    reg.register("s", slow_builder, num_workers=1, max_wait_ms=1.0)
+    reg.get("s")
+    assert reg.evict("s", timeout=30)
+    got, errs = [], []
+
+    def hit():
+        try:
+            got.append(reg.get("s"))
+        except Exception as err:  # noqa: BLE001 — recorded
+            errs.append(err)
+
+    threads = [threading.Thread(target=hit) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(calls) == 2               # initial load + ONE reload
+    assert all(s is got[0] for s in got)
+    assert reg.stats()["reloads"] == 1
+    assert reg.drain_all(timeout=30)
+
+
+def test_eviction_under_load_finishes_inflight_token_identically():
+    """The drain contract through the registry: a generation in flight
+    on the evicted model completes with exactly the tokens the
+    full-reforward oracle predicts; post-eviction submits get the
+    model-named ServerClosed; the next registry.get serves again."""
+    block = _gpt_block(seed=7)
+    reg = ModelRegistry()
+    reg.register("gpt",
+                 lambda: DecodeEngine(block, max_slots=2, name="gpt"),
+                 num_workers=1)
+    server = reg.get("gpt")
+    prompt = np.asarray([1, 4, 7], np.int32)
+    handle = server.submit(prompt, max_new_tokens=8)
+    evicted = threading.Thread(target=lambda: reg.evict("gpt",
+                                                        timeout=60))
+    evicted.start()
+    toks = handle.result(timeout=60)
+    evicted.join(timeout=60)
+    expect = block.generate_reference(prompt, max_new_tokens=8)
+    assert list(map(int, toks)) == list(map(int, expect))
+    with pytest.raises(ServerClosed) as err:
+        server.submit(prompt, max_new_tokens=2)
+    assert err.value.server is not None
+    # transparent reload serves the same tokens again
+    toks2 = reg.get("gpt").generate(prompt, max_new_tokens=8,
+                                    timeout=60)
+    assert list(map(int, toks2)) == list(map(int, expect))
+    assert reg.drain_all(timeout=30)
+
+
+def test_registry_closed_after_drain_all_and_gateway_restart():
+    """drain_all is terminal: a handler thread racing shutdown cannot
+    resurrect a drained model (the rebuilt engine would outlive the
+    released device lease) — it gets the model-named ServerClosed.
+    A restarted Gateway reopens the registry and serves again."""
+    reg = ModelRegistry()
+    reg.register("c", _mlp_builder(6, name="c"), eager=True,
+                 num_workers=1, max_wait_ms=1.0)
+    gw = Gateway(reg, port=0).start()
+    x = np.zeros((1, FEATURES), np.float32)
+    st, _ = _post(gw.url + "/v1/models/c:predict",
+                  {"inputs": x.tolist()})
+    assert st == 200
+    assert gw.close(timeout=30)
+    with pytest.raises(ServerClosed) as err:
+        reg.get("c")
+    assert err.value.server == "c"
+    # second life: start() reopens the registry, the eager model
+    # reloads (readyz gates on it), requests serve again
+    gw2 = Gateway(reg, port=0).start()
+    try:
+        assert gw2.ready()
+        st, _ = _post(gw2.url + "/v1/models/c:predict",
+                      {"inputs": x.tolist()})
+        assert st == 200
+    finally:
+        gw2.close(timeout=30)
+
+
+def test_registry_builder_failure_returns_to_cold():
+    state = {"fail": True}
+
+    def builder():
+        if state["fail"]:
+            raise RuntimeError("flaky load")
+        return _mlp_engine(2, name="flaky")
+
+    reg = ModelRegistry()
+    reg.register("f", builder, num_workers=1)
+    with pytest.raises(RuntimeError):
+        reg.get("f")
+    state["fail"] = False
+    assert reg.get("f") is reg.get("f")     # retried, now resident
+    assert reg.drain_all(timeout=30)
+
+
+# -- priority-class admission --------------------------------------------
+
+def test_admission_grants_strict_priority_order():
+    adm = _Admission(concurrency=1, queue_depth=8)
+    adm.enter("best_effort")                 # slot taken
+    order = []
+    done = threading.Event()
+
+    def waiter(cls):
+        adm.enter(cls)
+        order.append(cls)
+        adm.leave()
+        if len(order) == 3:
+            done.set()
+
+    # enqueue in REVERSE priority; grants must come back in priority
+    threads = []
+    for cls in ("best_effort", "batch", "interactive"):
+        t = threading.Thread(target=waiter, args=(cls,))
+        t.start()
+        threads.append(t)
+        time.sleep(0.1)       # deterministic queue arrival order
+    adm.leave()               # free the slot -> drain by priority
+    assert done.wait(10)
+    for t in threads:
+        t.join(10)
+    assert order == ["interactive", "batch", "best_effort"]
+
+
+def test_admission_sheds_queue_full_and_expired_deadline():
+    adm = _Admission(concurrency=1, queue_depth=1)
+    adm.enter("interactive")
+    blocker = threading.Thread(
+        target=lambda: (adm.enter("best_effort"), adm.leave()))
+    blocker.start()
+    time.sleep(0.1)           # the queue slot is now occupied
+    with pytest.raises(RequestRejected, match="queue full"):
+        adm.enter("best_effort")
+    assert adm.shed["best_effort"] == 1
+    # an expired deadline sheds BEFORE any compute slot is granted
+    with pytest.raises(DeadlineExceeded, match="shed before compute"):
+        adm.enter("interactive", Deadline(0.0, what="t"))
+    adm.leave()
+    blocker.join(10)
+    with pytest.raises(mx.base.MXNetError, match="priority"):
+        adm.enter("vip")
+
+
+# -- the HTTP front door --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gateway():
+    reg = ModelRegistry()
+    for i in range(3):
+        reg.register("m%d" % i, _mlp_builder(i), eager=(i < 2),
+                     num_workers=1, max_wait_ms=1.0)
+    reg.register("gpt",
+                 lambda: DecodeEngine(_gpt_block(seed=9), max_slots=2,
+                                      name="gpt"),
+                 num_workers=1)
+    gw = Gateway(reg, port=0, concurrency=2, queue_depth=4).start()
+    yield gw
+    gw.close(timeout=60)
+
+
+def test_http_predict_byte_identical_to_direct_infer(gateway):
+    x = np.linspace(-1, 1, FEATURES, dtype=np.float32)[None]
+    for name in ("m0", "m1"):
+        direct = np.asarray(gateway.registry.get(name).infer(
+            x, timeout=30)[0])
+        st, body = _post(gateway.url + "/v1/models/%s:predict" % name,
+                         {"inputs": x.tolist()})
+        assert st == 200, body
+        got = np.asarray(body["outputs"][0], np.float32)
+        assert np.array_equal(direct, got)    # byte-identical round trip
+    # distinct weights produced distinct answers (no routing mixup)
+    _, b0 = _post(gateway.url + "/v1/models/m0:predict",
+                  {"inputs": x.tolist()})
+    _, b1 = _post(gateway.url + "/v1/models/m1:predict",
+                  {"inputs": x.tolist()})
+    assert b0["outputs"] != b1["outputs"]
+
+
+def test_http_eviction_and_transparent_reload_under_budget(gateway):
+    """The E2E acceptance: 3 models under a budget that fits 2 — LRU
+    eviction + transparent reload over real HTTP, correct answers
+    throughout."""
+    reg = gateway.registry
+    x = np.ones((1, FEATURES), np.float32)
+    expected = {}
+    for name in ("m0", "m1", "m2"):
+        expected[name] = np.asarray(reg.get(name).infer(
+            x, timeout=30)[0])
+    per = max(s["bytes"] for s in reg.stats()["models"].values()
+              if s["bytes"])
+    reloads0 = reg.stats()["reloads"]
+    try:
+        reg.set_budget(budget_bytes=int(2.5 * per))
+        assert len(reg.resident()) == 2
+        for _ in range(2):
+            for name in ("m0", "m1", "m2"):
+                st, body = _post(
+                    gateway.url + "/v1/models/%s:predict" % name,
+                    {"inputs": x.tolist()})
+                assert st == 200, body
+                assert np.array_equal(
+                    expected[name],
+                    np.asarray(body["outputs"][0], np.float32))
+        assert reg.stats()["reloads"] > reloads0   # misses observed
+        st, body = _get(gateway.url + "/v1/models")
+        assert st == 200
+        assert len(body["models"]["resident"]) <= 2
+    finally:
+        reg.set_budget(budget_bytes=0)   # unbounded again
+
+
+def test_http_generate_stream_and_nonstream_token_identical(gateway):
+    prompt = [2, 5, 8]
+    direct = gateway.registry.get("gpt").generate(
+        np.asarray(prompt, np.int32), max_new_tokens=6, timeout=60)
+    st, body = _post(gateway.url + "/v1/models/gpt:generate",
+                     {"tokens": prompt, "max_new_tokens": 6})
+    assert st == 200, body
+    assert body["tokens"] == list(map(int, direct))
+    req = urllib.request.Request(
+        gateway.url + "/v1/models/gpt:generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.status == 200
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+    assert [l["token"] for l in lines if "token" in l] \
+        == list(map(int, direct))
+    assert lines[-1] == {"done": True, "tokens": 6}
+
+
+def test_http_shed_and_error_paths(gateway):
+    x = np.zeros((1, FEATURES), np.float32)
+    # expired deadline: shed in the admission queue, 504, never computed
+    served0 = gateway.registry.stats()["models"]["m0"]["requests"]
+    st, body = _post(gateway.url + "/v1/models/m0:predict",
+                     {"inputs": x.tolist(), "deadline_ms": 0})
+    assert st == 504 and "shed before compute" in body["error"]
+    assert gateway.registry.stats()["models"]["m0"]["requests"] \
+        == served0
+    st, body = _post(gateway.url + "/v1/models/nope:predict",
+                     {"inputs": x.tolist()})
+    assert st == 404 and "unknown model" in body["error"]
+    st, body = _post(gateway.url + "/v1/models/m0:predict",
+                     {"inputs": x.tolist(), "priority": "vip"})
+    assert st == 400
+    st, body = _post(gateway.url + "/v1/models/m0:generate",
+                     {"tokens": [1, 2]})
+    assert st in (400, 500)     # a forward model cannot generate
+    st, body = _get(gateway.url + "/no/such/route")
+    assert st == 404
+
+
+def test_http_keep_alive_survives_errors(gateway):
+    """One HTTP/1.1 keep-alive connection through every error shape:
+    the body is always drained (a 404-with-body must not poison the
+    next pipelined request), malformed payloads answer 400 instead of
+    killing the connection, and the connection keeps serving."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", gateway.port,
+                                      timeout=30)
+    try:
+        def post(path, payload):
+            conn.request("POST", path, body=json.dumps(payload))
+            r = conn.getresponse()
+            return r.status, json.loads(r.read())
+
+        x = [[0.0] * FEATURES]
+        st, _ = post("/nope", {"inputs": x})          # 404 with a body
+        assert st == 404
+        st, _ = post("/v1/models/m0:predict", {"inputs": x})
+        assert st == 200          # the connection was NOT poisoned
+        st, body = post("/v1/models/m0:predict",
+                        {"inputs": [[1, 2], [3]]})    # ragged
+        assert st == 400 and "ValueError" in body["error"]
+        st, _ = post("/v1/models/gpt:generate",
+                     {"tokens": [1], "max_new_tokens": "lots"})
+        assert st == 400
+        st, body = post("/v1/models/m0:generate",
+                        {"tokens": [1], "stream": True})
+        assert st == 400          # forward model has no token stream
+        assert "decode" in body["error"]
+        st, _ = post("/v1/models/m0:predict", {"inputs": x})
+        assert st == 200          # still serving after every error
+    finally:
+        conn.close()
+
+
+def test_http_healthz_and_chaos_admit(gateway):
+    st, body = _get(gateway.url + "/healthz")
+    assert st == 200 and body["ok"] is True
+    x = np.zeros((1, FEATURES), np.float32)
+    chaos.configure("gateway.admit:kind=fatal,n=1")
+    st, body = _post(gateway.url + "/v1/models/m0:predict",
+                     {"inputs": x.tolist()})
+    assert st == 500 and "chaos" in body["error"]
+    # one injected fault is one failed request, not a dead gateway
+    st, body = _post(gateway.url + "/v1/models/m0:predict",
+                     {"inputs": x.tolist()})
+    assert st == 200, body
+    chaos.configure("")
+
+
+def test_readyz_flips_only_after_every_eager_warmup():
+    """Boot readiness: the socket answers during the eager load, but
+    /readyz reads 503 until EVERY eager model finished loading (each
+    load runs the server warmup before the registry marks it
+    resident)."""
+    gate = threading.Event()
+
+    def slow_builder(seed):
+        def build():
+            gate.wait(30)
+            return _mlp_engine(seed, name="slow%d" % seed)
+        return build
+
+    reg = ModelRegistry()
+    reg.register("a", slow_builder(0), eager=True, num_workers=1)
+    reg.register("b", slow_builder(1), eager=True, num_workers=1)
+    gw = Gateway(reg, port=0, concurrency=2)
+    boot = threading.Thread(target=gw.start, daemon=True)
+    boot.start()
+    try:
+        for _ in range(100):
+            if gw._started:
+                break
+            time.sleep(0.05)
+        st, body = _get(gw.url + "/readyz")
+        assert st == 503 and body["ready"] is False
+        st, _ = _get(gw.url + "/healthz")
+        assert st == 200                      # alive, just not ready
+        gate.set()
+        boot.join(timeout=60)
+        assert not boot.is_alive()
+        st, body = _get(gw.url + "/readyz")
+        assert st == 200 and body["ready"] is True
+        assert sorted(body["resident"]) == ["a", "b"]
+    finally:
+        gate.set()
+        gw.close(timeout=30)
+
+
+def test_gateway_telemetry_report_and_perf_gate(tmp_path, monkeypatch):
+    """source="gateway" records feed the report's gateway section and
+    perf_gate's --max-p99-ms-class budget (exit 0 within, 1 breached)."""
+    stream = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY", stream)
+    reg = ModelRegistry()
+    reg.register("tm", _mlp_builder(4, name="tm"), eager=True,
+                 num_workers=1, max_wait_ms=1.0)
+    gw = Gateway(reg, port=0, concurrency=1, queue_depth=2).start()
+    x = np.zeros((1, FEATURES), np.float32)
+    try:
+        for cls in ("interactive", "batch", "best_effort"):
+            st, _ = _post(gw.url + "/v1/models/tm:predict",
+                          {"inputs": x.tolist(), "priority": cls})
+            assert st == 200
+        st, _ = _post(gw.url + "/v1/models/tm:predict",
+                      {"inputs": x.tolist(), "deadline_ms": 0})
+        assert st == 504
+        # an eviction + reload lands reload records on the stream too
+        reg.evict("tm", timeout=30)
+        st, _ = _post(gw.url + "/v1/models/tm:predict",
+                      {"inputs": x.tolist()})
+        assert st == 200
+    finally:
+        gw.close(timeout=30)
+        from mxnet_tpu.observability import telemetry
+        telemetry.close_stream()
+    monkeypatch.delenv("MXTPU_TELEMETRY")
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report_gw", os.path.join(ROOT, "tools",
+                                            "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    s = rep.summarize(rep.load_records(stream))
+    assert s["gateway_requests"] == 4
+    assert s["gateway_sheds"] == 1
+    assert s["gateway_reloads"] == 1
+    assert s["gateway_interactive_p99_ms"] > 0
+    assert s["gateway_shed_by_class"] == {"interactive": 1}
+    assert "gateway" in rep.format_summary(s)
+    gate = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "perf_gate.py"),
+         stream, "--max-p99-ms-class", "interactive=60000"],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+    gate = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "perf_gate.py"),
+         stream, "--max-p99-ms-class", "interactive=0.000001",
+         "--max-p99-ms-class", "batch=60000"],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 1
+    assert "gateway_interactive_p99_ms" in gate.stderr
+
+
+# -- tools/kill_stale.py gateway role ------------------------------------
+
+def _gateway_lease_record(pid, heartbeat_age=0.0, takeover_s=2.0):
+    return {"pid": pid, "host": socket.gethostname(),
+            "boot_id": open("/proc/sys/kernel/random/boot_id")
+            .read().strip(),
+            "starttime": _proc_starttime(pid), "what": "gateway",
+            "created": time.time() - heartbeat_age - 1.0,
+            "heartbeat": time.time() - heartbeat_age,
+            "heartbeat_s": 0.5, "takeover_s": takeover_s}
+
+
+def _kill_stale(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "kill_stale.py")]
+        + list(args), capture_output=True, text=True, timeout=120)
+
+
+def test_kill_stale_recognizes_and_refuses_fresh_gateway(tmp_path):
+    lease_path = str(tmp_path / "dev.lease")
+    holder = subprocess.Popen([sys.executable, "-S", "-c",
+                               "import time; time.sleep(600)"])
+    try:
+        time.sleep(0.2)
+        rec = _gateway_lease_record(holder.pid, takeover_s=600.0)
+        with open(lease_path, "w") as f:
+            f.write(json.dumps(rec))
+        r = _kill_stale("--kill", "--lease-path", lease_path)
+        assert r.returncode == 2, r.stdout + r.stderr
+        assert "role 'gateway'" in r.stdout
+        assert "GATEWAY" in r.stdout
+        assert holder.poll() is None
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_kill_stale_reaps_expired_gateway(tmp_path):
+    lease_path = str(tmp_path / "dev.lease")
+    holder = subprocess.Popen([sys.executable, "-S", "-c",
+                               "import time; time.sleep(600)"])
+    try:
+        time.sleep(0.2)
+        rec = _gateway_lease_record(holder.pid, heartbeat_age=100.0)
+        with open(lease_path, "w") as f:
+            f.write(json.dumps(rec))
+        r = _kill_stale("--kill", "--lease-path", lease_path)
+        holder.wait(timeout=10)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "GATEWAY-EXPIRED" in r.stdout
+        assert "-> killed" in r.stdout
+        assert not os.path.exists(lease_path)
+    finally:
+        if holder.poll() is None:
+            holder.kill()
+            holder.wait()

@@ -105,6 +105,44 @@ def test_serve_bench_smoke_coldstart():
         assert key in extra, extra
 
 
+def test_serve_bench_smoke_gateway():
+    """--mode gateway must stay runnable over real HTTP, and the
+    ISSUE-12 acceptance rides this record: under mixed-class overload
+    the interactive p99 stays within budget while best_effort absorbs
+    ALL sheds, responses stay parity-true, and the reload storm under
+    a fits-all-but-one budget observes LRU eviction + transparent
+    reload."""
+    out = _run(extra_env={"MXTPU_SERVE_BENCH_GATEWAY_MODELS": "3",
+                          "MXTPU_SERVE_BENCH_GATEWAY_REQUESTS": "8",
+                          "MXTPU_SERVE_BENCH_GATEWAY_ROUNDS": "3"},
+               args=("--mode", "gateway"))
+    assert out["metric"] == "serving_gateway_interactive_p99"
+    assert out["unit"] == "ms" and out["value"] > 0
+    assert out["platform"] == "cpu"
+    extra = out["extra"]
+    # the same request through HTTP and the direct in-process server
+    # must produce identical bytes, whatever the load
+    assert extra["parity"] is True
+    assert extra["errors"] == 0
+    # shed fairness: best_effort absorbs EVERY shed; interactive and
+    # batch traffic is never shed behind it
+    assert extra["fairness"] is True, extra
+    assert extra["shed_by_class"]["interactive"] == 0
+    assert extra["shed_by_class"]["batch"] == 0
+    assert extra["shed_by_class"]["best_effort"] > 0
+    # the interactive tail holds its budget under the overload
+    assert extra["interactive_p99_within_budget"] is True, extra
+    for cls in ("interactive", "batch", "best_effort"):
+        for key in ("p50_ms", "p95_ms", "p99_ms", "requests"):
+            assert key in extra[cls], extra
+    # reload storm: a budget that fits all but one model produced real
+    # evictions + transparent reloads, and a reload costs more than a
+    # resident hit (it rebuilds the engine, even cache-warm)
+    rl = extra["reload"]
+    assert rl["reloads"] > 0, rl
+    assert rl["reload_p50_ms"] > rl["hit_p50_ms"] > 0, rl
+
+
 @pytest.mark.slow
 def test_serve_bench_coldstart_meets_2x_acceptance():
     """ISSUE-11 acceptance: fresh-process warm start >= 2x faster than

@@ -21,6 +21,12 @@ Usage:
     python tools/kill_stale.py --kill --force --expired
                                           # even a fresh lease holder
 
+Serving front doors (mxnet_tpu/serving/gateway/, ISSUE 12) hold the
+lease with role "gateway"; kill_stale surfaces that role (tag GATEWAY
+/ GATEWAY-EXPIRED) and reaps a wedged one by the SAME ladder as
+training/serving holders: fresh heartbeat refused (exit 2), expired
+heartbeat reaped and the lease cleared.
+
 Supervised gangs (resilience/supervisor.py, ISSUE 8) are recognized by
 the MXTPU_GANG_DIR tag in a candidate's environment: when the gang's
 supervisor is alive (pid + starttime + boot id from
@@ -244,6 +250,11 @@ def find_candidates(init_grace=600, lease_path=None):
             "pid": pid, "cmd": cmdline[:160],
             "gang_dir": gdir,
             "supervised": sup_alive,
+            # the holder's recorded role ("gateway", "serving",
+            # "bench", ...) — a wedged front door is diagnosed by
+            # name, not by guessing from the cmdline
+            "lease_role": (str(lrec.get("what", ""))
+                           if is_holder and lrec else ""),
             "age_s": round(age, 1) if age is not None else -1.0,
             "cpu_s": round(cpu_s, 1) if cpu_s is not None else -1.0,
             "accel_mapped": maps_has_accel,
@@ -279,8 +290,9 @@ def main(argv=None):
     lease_path = args.lease_path or default_lease_path()
     lrec, lfresh, lalive = lease_state(lease_path)
     if lrec is not None:
-        print("lease %s: holder pid %s (%s, heartbeat %s)"
+        print("lease %s: holder pid %s role %r (%s, heartbeat %s)"
               % (lease_path, lrec.get("pid"),
+                 lrec.get("what", "?"),
                  "alive" if lalive else "dead",
                  "fresh" if lfresh else "EXPIRED"))
     cands = find_candidates(args.init_grace, lease_path=lease_path)
@@ -293,6 +305,11 @@ def main(argv=None):
     for c in cands:
         if c["supervised"]:
             tag = "SUPERVISED"
+        elif c["lease_holder"] and c.get("lease_role") == "gateway":
+            # the serving front door: same refusal/reap ladder as any
+            # holder, but named — a wedged gateway is a customer-facing
+            # outage and the operator should know what they're reaping
+            tag = "GATEWAY" if c["lease_fresh"] else "GATEWAY-EXPIRED"
         elif c["lease_holder"]:
             tag = "LEASE-HOLDER" if c["lease_fresh"] else "LEASE-EXPIRED"
         elif c["lease_risk"]:

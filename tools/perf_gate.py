@@ -32,6 +32,13 @@ is rejected):
                           (source="compile"; docs/compilation.md) — a
                           rollout/restart that re-pays full compile
                           must fail the gate, not ship
+    --max-p99-ms-class CLASS=MS
+                          per-priority-class gateway p99 latency budget
+                          in milliseconds over ``source="gateway"``
+                          request records (repeatable, e.g.
+                          ``--max-p99-ms-class interactive=50``) — the
+                          front door's interactive-tail CI gate
+                          (docs/serving.md "Front door & multiplexing")
     --min-steps           refuse a stream shorter than this (default 1
                           — a truncated run must not "pass")
 
@@ -90,6 +97,14 @@ def evaluate(summary, args):
     check("skipped_steps", "skipped_steps", args.max_skipped_steps, le)
     check("anomalies", "anomalies", args.max_anomalies, le)
     check("cold_start_s", "cold_start_max_s", args.max_cold_start_s, le)
+    for cls, budget in (args.class_p99_budgets or {}).items():
+        # gateway per-class tail budget (docs/serving.md): asserted
+        # over the source="gateway" request records' per-class p99.
+        # Absent metric = breach, same as every other budget — a gate
+        # demanding an interactive tail over a stream with no
+        # interactive traffic must fail loudly, not pass on silence.
+        check("gateway_%s_p99_ms" % cls, "gateway_%s_p99_ms" % cls,
+              budget, le)
     check("steps", "steps", args.min_steps, ge)
     return checks
 
@@ -109,15 +124,37 @@ def main(argv=None):
     ap.add_argument("--max-skipped-steps", type=float, default=None)
     ap.add_argument("--max-anomalies", type=float, default=None)
     ap.add_argument("--max-cold-start-s", type=float, default=None)
+    ap.add_argument("--max-p99-ms-class", action="append", default=None,
+                    metavar="CLASS=MS",
+                    help="per-priority-class gateway p99 latency "
+                         "budget in ms over source=\"gateway\" "
+                         "records, e.g. interactive=50 (repeatable)")
     ap.add_argument("--min-steps", type=float, default=1)
     args = ap.parse_args(argv)
+
+    verdict = {"path": args.path, "ok": False, "breaches": []}
+    args.class_p99_budgets = {}
+    for spec in args.max_p99_ms_class or ():
+        cls, eq, val = spec.partition("=")
+        cls = cls.strip()
+        try:
+            budget = float(val)
+        except ValueError:
+            budget = None
+        if not eq or not cls or budget is None:
+            verdict["error"] = ("--max-p99-ms-class wants CLASS=MS "
+                                "(e.g. interactive=50), got %r" % spec)
+            print(json.dumps(verdict))
+            print("perf_gate: %s" % verdict["error"], file=sys.stderr)
+            return 2
+        args.class_p99_budgets[cls] = budget
 
     budgets = (args.max_step_p50_s, args.max_step_p95_s,
                args.max_step_mean_s, args.max_compile_stall_s,
                args.max_compiles, args.min_samples_per_sec,
                args.max_data_wait_frac, args.max_skipped_steps,
-               args.max_anomalies, args.max_cold_start_s)
-    verdict = {"path": args.path, "ok": False, "breaches": []}
+               args.max_anomalies, args.max_cold_start_s,
+               args.class_p99_budgets or None)
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"
         print(json.dumps(verdict))

@@ -56,6 +56,26 @@ record is the before/after of docs/compilation.md (acceptance: warm
      "extra": {"cold_start_s": .., "warm_start_s": .., "speedup": ..,
                "cold": {cache/aot counters}, "warm": {...}}}
 
+`--mode gateway` benches the HTTP front door instead (ISSUE-12): N
+models are multiplexed behind one `Gateway` and driven over REAL HTTP
+in two phases. **Mixed load**: closed-loop interactive and batch
+clients plus a closed-loop best_effort flood sized past the
+best_effort class queue, against a small compute-slot pool — per-class
+p50/p95/p99 latency and shed fairness (strict-priority admission means
+best_effort's queue overflows while interactive and batch shed
+NOTHING). **Reload storm**: the registry budget is then shrunk to fit
+all-but-one model and requests round-robin across all of them, so
+every cycle LRU-evicts and transparently reloads — reload-miss latency
+vs resident-hit latency is the record's eviction story:
+
+    {"metric": "serving_gateway_interactive_p99", "value": ..,
+     "unit": "ms", "platform": "cpu",
+     "extra": {"interactive": {...}, "batch": {...},
+               "best_effort": {...}, "shed_by_class": {..},
+               "fairness": true, "interactive_p99_within_budget": true,
+               "reload": {"reloads": .., "reload_p95_ms": ..,
+                          "hit_p50_ms": ..}}}
+
 Env knobs (flags win): MXTPU_SERVE_BENCH_CLIENTS (16),
 MXTPU_SERVE_BENCH_REQUESTS (640 total), MXTPU_SERVE_BENCH_SERIAL (160),
 MXTPU_SERVE_BENCH_FEATURES (256), MXTPU_SERVE_BENCH_HIDDEN (256),
@@ -64,6 +84,12 @@ MXTPU_SERVE_BENCH_QUEUE (open-loop queue depth, 64).
 Coldstart knobs: MXTPU_SERVE_BENCH_COLD_DEPTH (56 FC layers),
 MXTPU_SERVE_BENCH_COLD_HIDDEN (192), MXTPU_SERVE_BENCH_COLD_BATCH (64
 max batch -> 7 padding buckets).
+Gateway knobs: MXTPU_SERVE_BENCH_GATEWAY_MODELS (3),
+MXTPU_SERVE_BENCH_GATEWAY_REQUESTS (12 per closed-loop client),
+MXTPU_SERVE_BENCH_GATEWAY_INTERACTIVE/BATCH/FLOOD clients (2/2/8),
+MXTPU_SERVE_BENCH_GATEWAY_CONCURRENCY (2),
+MXTPU_SERVE_BENCH_GATEWAY_QUEUE (4),
+MXTPU_SERVE_BENCH_GATEWAY_ROUNDS (reload-storm cycles, 4).
 Decode knobs: MXTPU_SERVE_BENCH_DECODE_SEQS (24 prompts),
 MXTPU_SERVE_BENCH_DECODE_SLOTS (8 cache slots),
 MXTPU_SERVE_BENCH_DECODE_NEW (16 tokens/request),
@@ -427,14 +453,236 @@ def run_coldstart(args_ns):
     }
 
 
+def _http_post(url, payload, timeout=120):
+    """POST JSON over the real wire; returns (status, parsed body,
+    latency_s). Shed/error statuses come back as values, not raises —
+    the bench records them."""
+    import urllib.error
+    import urllib.request
+    data = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = json.loads(r.read().decode("utf-8"))
+            return r.status, body, time.perf_counter() - t0
+    except urllib.error.HTTPError as err:
+        try:
+            body = json.loads(err.read().decode("utf-8"))
+        except ValueError:
+            body = {}
+        return err.code, body, time.perf_counter() - t0
+    except (urllib.error.URLError, ConnectionError, OSError) as err:
+        # a dropped/reset connection must not kill the client thread —
+        # it would silently truncate the offered load and fake the
+        # fairness/error numbers; 599 lands in the errors tally
+        return 599, {"error": repr(err)}, time.perf_counter() - t0
+
+
+def _gateway_class_summary(lats, sheds):
+    return {
+        "requests": len(lats), "shed": sheds,
+        "p50_ms": round(_percentile_ms(lats, 0.50), 3),
+        "p95_ms": round(_percentile_ms(lats, 0.95), 3),
+        "p99_ms": round(_percentile_ms(lats, 0.99), 3),
+    }
+
+
+def run_gateway(args_ns):
+    """The front-door bench (module docstring): mixed 3-class load over
+    real HTTP against N multiplexed models, then a reload storm under
+    a budget that fits all but one."""
+    import urllib.request
+    from mxnet_tpu.serving import Gateway, InferenceEngine, ModelRegistry
+
+    n_models = _env_int("MXTPU_SERVE_BENCH_GATEWAY_MODELS", 3)
+    per_client = _env_int("MXTPU_SERVE_BENCH_GATEWAY_REQUESTS", 12)
+    n_interactive = _env_int("MXTPU_SERVE_BENCH_GATEWAY_INTERACTIVE", 2)
+    n_batch = _env_int("MXTPU_SERVE_BENCH_GATEWAY_BATCH", 2)
+    n_flood = _env_int("MXTPU_SERVE_BENCH_GATEWAY_FLOOD", 8)
+    concurrency = _env_int("MXTPU_SERVE_BENCH_GATEWAY_CONCURRENCY", 2)
+    queue_depth = _env_int("MXTPU_SERVE_BENCH_GATEWAY_QUEUE", 4)
+    rounds = _env_int("MXTPU_SERVE_BENCH_GATEWAY_ROUNDS", 4)
+    features, hidden = args_ns.features, args_ns.hidden
+
+    # N models, SAME shapes (one compile set — the multiplexing under
+    # test is residency churn, not compile churn), different weights
+    # (so cross-model response mixups can't hide)
+    def builder(seed):
+        def build():
+            sym, params = _build_model(features, hidden, seed=seed)
+            return InferenceEngine.from_symbol(
+                sym, params, {}, {"data": (features,)},
+                max_batch_size=8, name="gwm%d" % seed)
+        return build
+
+    names = ["gwm%d" % i for i in range(n_models)]
+    registry = ModelRegistry(hbm_budget_mb=0, max_models=0)
+    for i, name in enumerate(names):
+        registry.register(name, builder(i), eager=True, num_workers=1,
+                          max_wait_ms=1.0)
+    gw = Gateway(registry, port=0, concurrency=concurrency,
+                 queue_depth=queue_depth).start()
+    base = gw.url
+    rng = np.random.RandomState(11)
+    xs = rng.randn(32, features).astype(np.float32)
+
+    def post(model, cls, i, deadline_ms=None):
+        payload = {"inputs": xs[i % len(xs)][None].tolist(),
+                   "priority": cls}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        return _http_post(base + "/v1/models/%s:predict" % model,
+                          payload)
+
+    try:
+        # output parity through the full HTTP path, against the direct
+        # in-process server — the same contract as the other modes
+        direct = np.asarray(registry.get(names[0]).infer(
+            xs[0:1], timeout=60)[0])
+        status, body, _ = post(names[0], "interactive", 0)
+        parity = status == 200 and np.array_equal(
+            direct, np.asarray(body["outputs"][0], np.float32))
+
+        # -- phase 1: mixed-class load -------------------------------
+        lats = {"interactive": [], "batch": [], "best_effort": []}
+        sheds = {"interactive": 0, "batch": 0, "best_effort": 0}
+        errors = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def closed_client(cls, idx, n):
+            for i in range(n):
+                st, body, dt = post(names[(idx + i) % n_models], cls, i)
+                with lock:
+                    if st == 200:
+                        lats[cls].append(dt)
+                    elif st in (503, 504):
+                        sheds[cls] += 1
+                    else:
+                        errors.append((st, body))
+
+        def flood_client(idx):
+            i = 0
+            while not stop.is_set():
+                st, body, dt = post(names[(idx + i) % n_models],
+                                    "best_effort", i)
+                with lock:
+                    if st == 200:
+                        lats["best_effort"].append(dt)
+                    elif st in (503, 504):
+                        sheds["best_effort"] += 1
+                    else:
+                        errors.append((st, body))
+                if st != 200:
+                    time.sleep(0.001)   # don't spin on instant sheds
+                i += 1
+
+        floods = [threading.Thread(target=flood_client, args=(i,))
+                  for i in range(n_flood)]
+        closed = [threading.Thread(target=closed_client,
+                                   args=("interactive", i, per_client))
+                  for i in range(n_interactive)]
+        closed += [threading.Thread(target=closed_client,
+                                    args=("batch", i, per_client))
+                   for i in range(n_batch)]
+        t0 = time.perf_counter()
+        for t in floods + closed:
+            t.start()
+        for t in closed:
+            t.join()
+        stop.set()
+        for t in floods:
+            t.join()
+        mixed_wall = time.perf_counter() - t0
+
+        # -- phase 2: reload storm under a fits-all-but-one budget ----
+        with urllib.request.urlopen(base + "/v1/models",
+                                    timeout=30) as r:
+            stats = json.loads(r.read())["models"]
+        per_bytes = max(s["bytes"] for s in stats["models"].values())
+        registry.set_budget(
+            budget_bytes=int((n_models - 0.5) * per_bytes))
+        # cycling N models through N-1 residency slots is LRU's worst
+        # case: every cycle access misses (that's the storm). The hit
+        # baseline is measured deterministically by re-requesting the
+        # model that just (re)loaded — it is provably resident.
+        reload_lats, hit_lats = [], []
+        reloads_before = registry.stats()["reloads"]
+        for rnd in range(rounds):
+            for name in names:
+                before = registry.stats()["reloads"]
+                st, body, dt = post(name, "interactive", rnd)
+                if st != 200:
+                    errors.append((st, body))
+                elif registry.stats()["reloads"] > before:
+                    reload_lats.append(dt)
+                else:
+                    hit_lats.append(dt)
+                st, body, dt = post(name, "interactive", rnd)
+                if st != 200:
+                    errors.append((st, body))
+                else:
+                    hit_lats.append(dt)
+        reloads = registry.stats()["reloads"] - reloads_before
+        gw_stats = gw.stats()
+    finally:
+        gw.close(timeout=60)
+
+    fairness = (sheds["interactive"] == 0 and sheds["batch"] == 0
+                and sheds["best_effort"] > 0)
+    p99_budget = float(args_ns.gateway_p99_budget_ms)
+    interactive_p99 = _percentile_ms(lats["interactive"], 0.99)
+    return {
+        "metric": "serving_gateway_interactive_p99",
+        "value": round(interactive_p99, 3), "unit": "ms",
+        "extra": {
+            "models": n_models, "features": features, "hidden": hidden,
+            "concurrency": concurrency, "queue_depth": queue_depth,
+            "mixed_wall_s": round(mixed_wall, 4),
+            "parity": bool(parity),
+            "errors": len(errors),
+            "interactive": _gateway_class_summary(
+                lats["interactive"], sheds["interactive"]),
+            "batch": _gateway_class_summary(lats["batch"],
+                                            sheds["batch"]),
+            "best_effort": _gateway_class_summary(
+                lats["best_effort"], sheds["best_effort"]),
+            "shed_by_class": dict(sheds),
+            "fairness": fairness,
+            "interactive_p99_budget_ms": p99_budget,
+            "interactive_p99_within_budget":
+                bool(interactive_p99 <= p99_budget),
+            "admission": {"granted": gw_stats["granted"],
+                          "shed": gw_stats["shed"]},
+            "reload": {
+                "rounds": rounds, "reloads": reloads,
+                "per_model_bytes": per_bytes,
+                "reload_p50_ms": round(
+                    _percentile_ms(reload_lats, 0.50), 3),
+                "reload_p95_ms": round(
+                    _percentile_ms(reload_lats, 0.95), 3),
+                "hit_p50_ms": round(_percentile_ms(hit_lats, 0.50), 3),
+            },
+        },
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="serving load generator "
                     "(closed/open/decode/coldstart)")
     parser.add_argument("--mode",
                         choices=("closed", "open", "both", "decode",
-                                 "coldstart"),
+                                 "coldstart", "gateway"),
                         default="closed")
+    parser.add_argument("--gateway-p99-budget-ms", type=float,
+                        default=float(os.environ.get(
+                            "MXTPU_SERVE_BENCH_GATEWAY_P99_MS", 2500)),
+                        help="interactive p99 budget asserted into the "
+                             "gateway record (CPU smoke default "
+                             "2500ms)")
     parser.add_argument("--clients", type=int,
                         default=_env_int("MXTPU_SERVE_BENCH_CLIENTS", 16))
     parser.add_argument("--requests", type=int,
@@ -477,6 +725,12 @@ def main(argv=None):
 
     if args_ns.mode == "decode":
         record = run_decode(args_ns)
+        record["platform"] = jax.default_backend()
+        print(json.dumps(record))
+        return 0
+
+    if args_ns.mode == "gateway":
+        record = run_gateway(args_ns)
         record["platform"] = jax.default_backend()
         print(json.dumps(record))
         return 0

@@ -75,7 +75,8 @@ def summarize(records):
     # (a serving-only file keeps its records)
     core = [r for r in records
             if not str(r.get("source", "")).startswith(
-                ("serving", "decode", "resilience", "compile"))] \
+                ("serving", "decode", "resilience", "compile",
+                 "gateway"))] \
         or records
     step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
@@ -198,6 +199,47 @@ def summarize(records):
             summary["decode_intertoken_p50_s"] = _percentile(gaps, 0.50)
             summary["decode_intertoken_p95_s"] = _percentile(gaps, 0.95)
             summary["decode_intertoken_p99_s"] = _percentile(gaps, 0.99)
+    # gateway section (docs/serving.md "Front door & multiplexing"):
+    # the HTTP front door emits one record per served request
+    # (event="request", step_time = receive -> respond latency, with
+    # the priority class), one per shed (event="shed", with the
+    # reason), and the registry adds reload/evict events — per-CLASS
+    # latency percentiles are the SLO surface perf_gate's
+    # --max-p99-ms-class budgets read
+    gw = [r for r in records if r.get("source") == "gateway"]
+    if gw:
+        # event="request" records are SERVED (status 200) requests —
+        # the per-class percentiles below are the SLO surface, so
+        # error outcomes (event="error": 4xx/5xx/disconnects) are
+        # counted separately and never dilute the latency tails
+        gw_reqs = [r for r in gw if r.get("event") == "request"
+                   and r.get("status", 200) == 200]
+        gw_sheds = [r for r in gw if r.get("event") == "shed"]
+        gw_errors = [r for r in gw if r.get("event") == "error"]
+        gw_reloads = sorted(float(r["step_time"]) for r in gw
+                            if r.get("event") == "reload")
+        summary["gateway_requests"] = len(gw_reqs)
+        summary["gateway_sheds"] = len(gw_sheds)
+        summary["gateway_errors"] = len(gw_errors)
+        summary["gateway_models"] = sorted(
+            {str(r.get("model", "?")) for r in gw_reqs})
+        for cls in sorted({str(r.get("class", "?")) for r in gw_reqs}):
+            lat = sorted(1000.0 * float(r["step_time"])
+                         for r in gw_reqs if r.get("class") == cls)
+            summary["gateway_%s_requests" % cls] = len(lat)
+            summary["gateway_%s_p50_ms" % cls] = _percentile(lat, 0.50)
+            summary["gateway_%s_p95_ms" % cls] = _percentile(lat, 0.95)
+            summary["gateway_%s_p99_ms" % cls] = _percentile(lat, 0.99)
+        shed_by_class = {}
+        for r in gw_sheds:
+            cls = str(r.get("class", "?"))
+            shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+        summary["gateway_shed_by_class"] = shed_by_class
+        summary["gateway_reloads"] = len(gw_reloads)
+        if gw_reloads:
+            summary["gateway_reload_p95_s"] = _percentile(gw_reloads,
+                                                          0.95)
+            summary["gateway_reload_max_s"] = gw_reloads[-1]
     # numerics section (docs/fault_tolerance.md "Training numerics
     # guard"): skipped_steps/anomalies are per-step counter deltas on
     # TRAINING records (the resilience events describing the same
@@ -396,6 +438,25 @@ def format_summary(s):
                    s["decode_intertoken_p95_s"],
                    s["decode_intertoken_p99_s"],
                    s.get("decode_step_p50_s", 0.0)))
+    if "gateway_requests" in s:
+        lines.append(
+            "  gateway     %d requests (%d models)  %d shed  "
+            "%d error(s)  %d reload(s)%s"
+            % (s["gateway_requests"], len(s.get("gateway_models", [])),
+               s["gateway_sheds"], s.get("gateway_errors", 0),
+               s.get("gateway_reloads", 0),
+               ("  reload max %.3fs" % s["gateway_reload_max_s"]
+                if "gateway_reload_max_s" in s else "")))
+        for cls in ("interactive", "batch", "best_effort"):
+            if ("gateway_%s_requests" % cls) in s:
+                lines.append(
+                    "              %-12s %4d req  p50 %.1fms  "
+                    "p95 %.1fms  p99 %.1fms  shed %d"
+                    % (cls, s["gateway_%s_requests" % cls],
+                       s["gateway_%s_p50_ms" % cls],
+                       s["gateway_%s_p95_ms" % cls],
+                       s["gateway_%s_p99_ms" % cls],
+                       s.get("gateway_shed_by_class", {}).get(cls, 0)))
     if s.get("skipped_steps") or s.get("anomalies") \
             or s.get("numerics_rollbacks") or s.get("sdc_suspected") \
             or "loss_scale_last" in s:
