@@ -109,6 +109,12 @@ class Trainer:
     def _ensure_ready(self):
         if not self._ready:
             self._resolve_sync()
+            # live introspection plane (docs/observability.md): a
+            # training rank binds /metricsz + /debugz when
+            # MXTPU_METRICS_PORT is set — one env read, no socket
+            # otherwise
+            from ..observability import httpz as _httpz
+            _httpz.maybe_start()
 
     def _trainable(self):
         """(slot, param) pairs that actually carry gradients."""

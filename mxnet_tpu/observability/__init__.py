@@ -20,12 +20,28 @@ Counters ship ON by default (near-free); JSONL step streaming ships OFF
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        REGISTRY, counter, gauge, histogram,
                        DEFAULT_BUCKETS)
-from .span import span, current_span
+from .span import span, current_span, capture_context, restored
+from .trace import (TraceContext, trace_span, record_span,
+                    device_annotation)
+from . import trace
 from .telemetry import (StepTimer, stream_path, stream_enabled, emit,
                         close_stream)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
-           "span", "current_span",
+           "span", "current_span", "capture_context", "restored",
+           "TraceContext", "trace_span", "record_span",
+           "device_annotation", "trace",
            "StepTimer", "stream_path", "stream_enabled", "emit",
-           "close_stream"]
+           "close_stream", "ObservabilityServer", "debug_snapshot"]
+
+
+def __getattr__(name):
+    # the live-plane server pulls in http.server; keep that chain out
+    # of `import mxnet_tpu` (cold start is a gated metric) — every
+    # runtime call site already imports httpz lazily too
+    if name in ("ObservabilityServer", "debug_snapshot"):
+        from . import httpz
+        return getattr(httpz, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

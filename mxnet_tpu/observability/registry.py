@@ -21,12 +21,42 @@ records is separately gated by MXTPU_TELEMETRY (telemetry.py).
 from __future__ import annotations
 
 import json
+import os
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-           "counter", "gauge", "histogram", "DEFAULT_BUCKETS"]
+           "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
+           "OVERFLOW_KEY"]
 
 _INF = float("inf")
+
+#: the collapsed labelset unbounded-cardinality writes land in once a
+#: metric holds MXTPU_METRIC_MAX_LABELS distinct labelsets — tracing
+#: adds per-model/per-class/per-trace labels, and a label leak must
+#: cost one extra series + a counter bump, never unbounded registry
+#: memory
+OVERFLOW_KEY = (("overflow", "true"),)
+
+#: name of the drop counter; exempt from its own collapse (bounded by
+#: the number of registered metrics, and collapsing it would recurse)
+_DROPPED_NAME = "observability.labels.dropped"
+
+
+def _max_labels():
+    """MXTPU_METRIC_MAX_LABELS, re-read per new labelset (a dict
+    lookup; only paid when a label combination is seen first)."""
+    try:
+        return int(os.environ.get("MXTPU_METRIC_MAX_LABELS") or 256)
+    except ValueError:
+        return 256
+
+
+def _exemplar_k():
+    """Worst-K exemplars retained per histogram labelset."""
+    try:
+        return int(os.environ.get("MXTPU_TRACE_EXEMPLARS") or 4)
+    except ValueError:
+        return 4
 
 # latency-oriented default: 0.5ms .. 60s, roughly x2.5 per step
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -71,6 +101,27 @@ class _Metric:
         self._lock = threading.Lock()
         self._values = {}
 
+    def _key(self, labels):
+        """Canonical key for a WRITE, cardinality-bounded: a labelset
+        past `MXTPU_METRIC_MAX_LABELS` distinct combinations collapses
+        into the shared ``overflow="true"`` series and bumps
+        `observability.labels.dropped` (label ``metric``). Caller
+        holds self._lock. Reads keep the exact `_label_key` — a
+        collapsed series is still readable via
+        ``get(overflow="true")``."""
+        key = _label_key(labels)
+        if not key or key in self._values or key == OVERFLOW_KEY \
+                or self.name == _DROPPED_NAME:
+            return key
+        if len(self._values) >= _max_labels():
+            # bump outside our lock discipline concern: the dropped
+            # counter is a DIFFERENT metric object (never collapses,
+            # never calls back into another metric), so metric-lock →
+            # dropped-lock is the only ordering that occurs
+            _labels_dropped().inc(metric=self.name)
+            return OVERFLOW_KEY
+        return key
+
     def labelsets(self):
         with self._lock:
             return list(self._values.keys())
@@ -90,8 +141,8 @@ class Counter(_Metric):
         if n < 0:
             raise ValueError("Counter %r cannot decrease (got %r)"
                              % (self.name, n))
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             self._values[key] = self._values.get(key, 0) + n
 
     def get(self, **labels):
@@ -111,13 +162,13 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def set(self, value, **labels):
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             self._values[key] = value
 
     def inc(self, n=1, **labels):
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             self._values[key] = self._values.get(key, 0) + n
 
     def dec(self, n=1, **labels):
@@ -145,13 +196,19 @@ class Histogram(_Metric):
         cell = self._values.get(key)
         if cell is None:
             cell = self._values[key] = {
-                "counts": [0] * len(self.buckets), "sum": 0.0, "count": 0}
+                "counts": [0] * len(self.buckets), "sum": 0.0,
+                "count": 0, "exemplars": []}
         return cell
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
+        """Record one observation. `exemplar` (a trace id, typically)
+        tags the sample: each labelset retains the worst
+        `MXTPU_TRACE_EXEMPLARS` (value, exemplar) pairs, so a p99
+        breach can name a concrete traceable request instead of a bare
+        percentile (docs/observability.md "Exemplars")."""
         value = float(value)
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             cell = self._cell(key)
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
@@ -159,6 +216,19 @@ class Histogram(_Metric):
                     break
             cell["sum"] += value
             cell["count"] += 1
+            if exemplar is not None:
+                worst = cell.get("exemplars")
+                if worst is None:
+                    worst = cell["exemplars"] = []
+                worst.append((value, str(exemplar)))
+                worst.sort(key=lambda p: -p[0])
+                del worst[_exemplar_k():]
+
+    def exemplars(self, **labels):
+        """Worst-K retained (value, exemplar) pairs, largest first."""
+        with self._lock:
+            cell = self._values.get(_label_key(labels))
+            return list(cell.get("exemplars", ())) if cell else []
 
     def sum(self, **labels):
         with self._lock:
@@ -261,9 +331,12 @@ class MetricsRegistry:
             for key in sorted(m.labelsets()):
                 labels = dict(key)
                 if m.kind == "histogram":
-                    rows.append((m.name, m.kind, labels,
-                                 {"count": m.count(**labels),
-                                  "sum": m.sum(**labels)}))
+                    summary = {"count": m.count(**labels),
+                               "sum": m.sum(**labels)}
+                    ex = m.exemplars(**labels)
+                    if ex:
+                        summary["exemplars"] = ex
+                    rows.append((m.name, m.kind, labels, summary))
                 else:
                     rows.append((m.name, m.kind, labels, m.get(**labels)))
         return rows
@@ -320,6 +393,15 @@ class MetricsRegistry:
 
 #: Process-wide default registry; module-level helpers bind to it.
 REGISTRY = MetricsRegistry()
+
+
+def _labels_dropped():
+    # literal name (== _DROPPED_NAME): tools/docs_drift.py audits
+    # literal registrations against docs/observability.md
+    return REGISTRY.counter(
+        "observability.labels.dropped",
+        "labelsets collapsed into the overflow series past "
+        "MXTPU_METRIC_MAX_LABELS (label metric)")
 
 
 def counter(name, help=""):

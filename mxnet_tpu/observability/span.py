@@ -16,12 +16,14 @@ event append — so spans can stay in hot paths permanently
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
 from ..profiler import _record_event, _running
+from . import trace as _trace
 
-__all__ = ["span", "current_span"]
+__all__ = ["span", "current_span", "capture_context", "restored"]
 
 _tls = threading.local()
 
@@ -37,6 +39,33 @@ def current_span():
     """Name of the innermost active span on this thread, or None."""
     stack = getattr(_tls, "stack", None)
     return stack[-1] if stack else None
+
+
+def capture_context():
+    """Snapshot the calling thread's span context — the legacy span
+    name stack AND the distributed `TraceContext` — for crossing a
+    thread-pool boundary. A span opened on a worker thread used to
+    become an orphaned root because the parent lived in the submitting
+    thread's thread-local; capture at submit, `restored()` at
+    execution, and it parents to the submitting request instead.
+    Cheap when nothing is active: an empty tuple copy + one attr read."""
+    stack = getattr(_tls, "stack", None)
+    return (tuple(stack) if stack else (), _trace.capture())
+
+
+@contextlib.contextmanager
+def restored(captured):
+    """Install a `capture_context()` snapshot on the executing thread
+    for the duration of the block (both the span parent stack and the
+    trace context), restoring the thread's own context after."""
+    stack, ctx = captured if captured else ((), None)
+    prev_stack = getattr(_tls, "stack", None)
+    _tls.stack = list(stack)
+    with _trace.attached(ctx):
+        try:
+            yield
+        finally:
+            _tls.stack = prev_stack if prev_stack is not None else []
 
 
 class span:

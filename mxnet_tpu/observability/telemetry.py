@@ -25,6 +25,7 @@ import threading
 import time
 import warnings
 
+from . import trace as _trace
 from .registry import REGISTRY, counter, gauge, histogram
 from .span import span
 
@@ -215,24 +216,28 @@ def _counters_snapshot():
 
 
 class _Phase:
-    """Accumulates one named phase's wall time into its StepTimer and
-    doubles as a profiler span, so phases appear in the chrome trace
-    whenever the profiler runs."""
+    """Accumulates one named phase's wall time into its StepTimer,
+    doubles as a profiler span (chrome trace whenever the profiler
+    runs), and as a trace span child of the step's trace root (the
+    merged per-step timeline in tools/trace_report.py)."""
 
-    __slots__ = ("_timer", "_name", "_t0", "_span")
+    __slots__ = ("_timer", "_name", "_t0", "_span", "_tspan")
 
     def __init__(self, timer, name):
         self._timer = timer
         self._name = name
         self._span = span("step/" + name)
+        self._tspan = _trace.trace_span(name)
 
     def __enter__(self):
         self._span.__enter__()
+        self._tspan.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._t0
+        self._tspan.__exit__(*exc)
         self._span.__exit__(*exc)
         phases = self._timer._phases
         phases[self._name] = phases.get(self._name, 0.0) + dt
@@ -270,14 +275,41 @@ class StepTimer:
         self._phases = {}
         self._last_end = None
         self._snap = None
+        self._trace_span = None
 
     def begin_step(self):
         # a failed step never reached end_step: drop its phase times so
-        # the aborted attempt doesn't inflate the next record
+        # the aborted attempt doesn't inflate the next record, and
+        # close its abandoned trace root (restores this thread's ctx)
         self._phases = {}
-        if self._last_end is None:
+        if self._trace_span is not None:
+            self._trace_span.__exit__(None, None, None)
+            self._trace_span = None
+        first = self._last_end is None
+        if first:
             self._last_end = time.perf_counter()
             self._snap = _counters_snapshot()
+        # live introspection plane: training ranks bind /metricsz +
+        # /debugz when MXTPU_METRICS_PORT is set (one env read here)
+        from . import httpz as _httpz
+        _httpz.maybe_start()
+        # per-step trace root (docs/observability.md "Distributed
+        # tracing"): trace id hashed from (gang dir, source, step) so
+        # all ranks share it; t0 backdated to the previous step's end,
+        # so the root covers the FULL iteration (fwd/bwd included)
+        ctx = _trace.step_trace_context(self.source, self.step)
+        if ctx is not None:
+            sp = _trace.trace_span("step", ctx=ctx, t0=self._last_end,
+                                   step=self.step, source=self.source)
+            sp.__enter__()
+            self._trace_span = sp
+            now = time.perf_counter()
+            if not first and sp.span_id and now - self._last_end > 1e-6:
+                # retroactive child covering previous-end -> here: the
+                # forward/backward + input window that ran before the
+                # trainer's step() call
+                _trace.record_span("fwd_bwd", _trace.current(),
+                                   self._last_end, now)
 
     def phase(self, name):
         return _Phase(self, name)
@@ -332,8 +364,18 @@ class StepTimer:
             if step_time > 0:
                 record["samples_per_sec"] = batch_size / step_time
         record.update(extra)
+        trace_id = None
+        if self._trace_span is not None:
+            if self._trace_span.span_id:
+                trace_id = self._trace_span.ctx.trace_id
+                record["trace_id"] = trace_id
+            self._trace_span.__exit__(None, None, None)
+            self._trace_span = None
         self.step += 1
-        STEP_SECONDS.observe(step_time, source=self.source)
+        # worst-K step times retain their trace ids as exemplars: a
+        # step-time p99 breach names a concrete traceable step
+        STEP_SECONDS.observe(step_time, exemplar=trace_id,
+                             source=self.source)
         if stream_path() is not None:
             emit(record)
         return record

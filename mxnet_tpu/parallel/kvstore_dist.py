@@ -28,6 +28,7 @@ from ..kvstore import (KVStore, _key_value, _nbytes, _priority_order,
                        _sum_arrays, _PUSH_BYTES, _PUSH_CALLS,
                        _PUSH_SECONDS)
 from ..observability import registry as _obs
+from ..observability import trace as _trace
 from ..resilience import lease as _lease
 from ..resilience import numerics as _num
 from ..resilience import supervisor as _sup
@@ -190,6 +191,10 @@ def init_distributed(coordinator_address=None, num_processes=None,
         # the moment the rank is known, so peers can prove us dead in
         # seconds instead of waiting out a collective watchdog
         _sup.ensure_rank_heartbeat(jax.process_index())
+    # live introspection plane (docs/observability.md): each rank binds
+    # /metricsz + /debugz at MXTPU_METRICS_PORT + rank when configured
+    from ..observability import httpz as _httpz
+    _httpz.maybe_start()
 
 
 class DistKVStore(KVStore):
@@ -324,9 +329,17 @@ class DistKVStore(KVStore):
             items.append((k, tuple(m.shape), str(m.dtype), int(pr), lane))
         policy = self._push_policy()
         issued = []
-        for bucket in self._bucketer.plan(items):
-            out = retry_call(self._issue_bucket, bucket, merged,
-                             policy=policy)
+        for i, bucket in enumerate(self._bucketer.plan(items)):
+            # one trace span per fusion bucket, child of the step's
+            # trace root (StepTimer's id is deterministic across
+            # ranks, so the merged per-step trace carries EVERY
+            # rank's exchange spans side by side — the slow-peer
+            # diagnosis the JSONL percentiles can't make)
+            with _trace.trace_span("exchange/bucket", bucket=i,
+                                   keys=len(bucket.keys),
+                                   bytes=int(bucket.nbytes)):
+                out = retry_call(self._issue_bucket, bucket, merged,
+                                 policy=policy)
             issued.append((bucket, out))
         guard = _num.enabled()
         for bucket, out in issued:

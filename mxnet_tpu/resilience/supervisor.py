@@ -573,11 +573,22 @@ class GangSupervisor:
                 pass
         if self.generation == 0:
             # a reused gang dir must not attribute a PREVIOUS run's
-            # cold-start records to this run's downtime split
+            # cold-start records to this run's downtime split — nor
+            # merge a previous run's trace shards into this run's
+            # per-step traces (step trace ids hash the gang dir, so a
+            # stale shard would collide with this run's step numbers)
+            stale = ["coldstart.jsonl"]
             try:
-                os.unlink(os.path.join(self.dir, "coldstart.jsonl"))
+                stale += [n for n in os.listdir(self.dir)
+                          if n.startswith("trace_rank_")
+                          and n.endswith(".jsonl")]
             except OSError:
                 pass
+            for name in stale:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
         self._write_record()
         self._ensure_heartbeat_thread()
         coordinator = "127.0.0.1:%d" % _free_port()

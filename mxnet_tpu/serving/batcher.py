@@ -41,6 +41,7 @@ import numpy as np
 from ..base import MXNetError, getenv
 from ..ndarray import NDArray
 from ..observability import registry as _obs
+from ..observability.span import capture_context
 from ..resilience import DeadlineExceeded
 
 __all__ = ["DynamicBatcher", "InferenceRequest", "RequestRejected",
@@ -87,8 +88,9 @@ class InferenceRequest:
     serialized anyway; a device handle per request would re-pay the
     dispatch overhead coalescing amortized)."""
 
-    __slots__ = ("inputs", "n", "deadline", "source", "enqueued_at",
-                 "resolved_at", "_event", "_outputs", "_error")
+    __slots__ = ("inputs", "n", "deadline", "source", "trace",
+                 "enqueued_at", "resolved_at", "_event", "_outputs",
+                 "_error")
 
     def __init__(self, inputs, n, deadline=None, source="default"):
         self.inputs = inputs
@@ -97,6 +99,10 @@ class InferenceRequest:
         self.source = source      # owning batcher/server, the latency
         #                           histogram label — two servers in
         #                           one process must not blend tails
+        # captured span/trace context of the SUBMITTING thread: the
+        # worker that executes this request restores it, so its spans
+        # parent to the request instead of orphaning at the queue hop
+        self.trace = capture_context()
         self.enqueued_at = time.perf_counter()
         self.resolved_at = None     # stamped at resolve/reject — the
         #                             completion time a load generator
@@ -105,10 +111,18 @@ class InferenceRequest:
         self._outputs = None
         self._error = None
 
+    def trace_context(self):
+        """The request's `TraceContext` (or None) — the retroactive
+        queue/compute spans the consumer records hang off it."""
+        ctx = self.trace[1] if self.trace else None
+        return ctx if ctx is not None and ctx.sampled else None
+
     # -- consumer side ---------------------------------------------------
     def resolve(self, outputs):
         self.resolved_at = time.perf_counter()
+        ctx = self.trace_context()
         _LATENCY.observe(self.resolved_at - self.enqueued_at,
+                         exemplar=ctx.trace_id if ctx else None,
                          server=self.source)
         self._outputs = outputs
         self._event.set()

@@ -44,6 +44,7 @@ from ..base import MXNetError, getenv
 from ..compile import aot as _aot
 from ..compile.cache import enable_cache
 from ..observability import registry as _obs
+from ..observability import trace as _trace
 from .engine import bucket_sizes, resolve_serve_dtype
 
 __all__ = ["DecodeEngine"]
@@ -390,17 +391,21 @@ class DecodeEngine:
             args = (self._params,
                     jax.device_put(jnp.asarray(padded), self.device),
                     jax.device_put(jnp.int32(n), self.device))
-        out = self._aot_call(("prefill", bucket), args)
-        if out is None:
-            out = self._prefill_jit(*args)
-            self._count_compile(("prefill", bucket))
-        next_token, k_seq, v_seq = out
-        admit_args = (self._cache_k, self._cache_v, self._positions,
-                      k_seq, v_seq, jnp.int32(slot), jnp.int32(n))
-        admitted = self._aot_call("admit", admit_args)
-        if admitted is None:
-            admitted = self._admit_jit(*admit_args)
-            self._count_compile("admit")
+        # prefill + admit run under the requesting trace's
+        # TraceAnnotation (the scheduler restores the submit context),
+        # so the XLA profiler names which request's prefill this is
+        with _trace.device_annotation():
+            out = self._aot_call(("prefill", bucket), args)
+            if out is None:
+                out = self._prefill_jit(*args)
+                self._count_compile(("prefill", bucket))
+            next_token, k_seq, v_seq = out
+            admit_args = (self._cache_k, self._cache_v, self._positions,
+                          k_seq, v_seq, jnp.int32(slot), jnp.int32(n))
+            admitted = self._aot_call("admit", admit_args)
+            if admitted is None:
+                admitted = self._admit_jit(*admit_args)
+                self._count_compile("admit")
         self._cache_k, self._cache_v, self._positions = admitted
         first = int(next_token)
         self.positions[slot] = n

@@ -36,6 +36,7 @@ from ..compile.cache import enable_cache
 from ..graph import build_graph_fn, collect_vars, infer_structs
 from ..ndarray import NDArray
 from ..observability import registry as _obs
+from ..observability import trace as _trace
 
 __all__ = ["InferenceEngine", "bucket_sizes", "resolve_serve_dtype"]
 
@@ -651,38 +652,43 @@ class InferenceEngine:
             params = {**params, **phantoms}
         outs = None
         aot_fn = self._aot_fn_for(bucket, device)
-        if aot_fn is not None:
-            try:
-                # the AOT-loaded executable: no trace, no compile —
-                # first dispatch marks the bucket warm without touching
-                # the compile counter (nothing compiled)
-                outs = aot_fn(data, params, aux, key)
+        # device dispatch rides a jax TraceAnnotation named by the
+        # caller's trace id (the server attaches the request context),
+        # so XLA profiler device rows correlate with the host spans
+        with _trace.device_annotation():
+            if aot_fn is not None:
+                try:
+                    # the AOT-loaded executable: no trace, no compile —
+                    # first dispatch marks the bucket warm without
+                    # touching the compile counter (nothing compiled)
+                    outs = aot_fn(data, params, aux, key)
+                    with self._lock:
+                        self._compiled.add(compile_key)
+                except Exception:  # noqa: BLE001 — failure = JIT path
+                    with self._lock:
+                        self._aot.pop(bucket, None)
+                    _aot.FALLBACKS.inc(reason="dispatch")
+                    data = stage()  # the failed call may have donated it
+            if outs is None and compiling:
+                # a forward-only program often can't alias the donated
+                # request buffer into its outputs; that's fine (donation
+                # still frees it for intermediates) — silence XLA's
+                # per-compile nag on the one dispatch that lowers
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    outs = self._jit(data, params, aux, key)
+                # account AFTER the dispatch succeeded: a failed first
+                # dispatch must not mark the bucket warm (warmup()
+                # would skip it) or count a compile that never finished
                 with self._lock:
-                    self._compiled.add(compile_key)
-            except Exception:  # noqa: BLE001 — any failure = JIT path
-                with self._lock:
-                    self._aot.pop(bucket, None)
-                _aot.FALLBACKS.inc(reason="dispatch")
-                data = stage()   # the failed call may have donated it
-        if outs is None and compiling:
-            # a forward-only program often can't alias the donated
-            # request buffer into its outputs; that's fine (donation
-            # still frees it for intermediates) — silence XLA's
-            # per-compile nag on the one dispatch that lowers
-            with warnings.catch_warnings():
-                warnings.filterwarnings(
-                    "ignore",
-                    message="Some donated buffers were not usable")
+                    if compile_key not in self._compiled:
+                        self._compiled.add(compile_key)
+                        _COMPILES.inc(engine=self.name,
+                                      bucket=str(bucket))
+            elif outs is None:
                 outs = self._jit(data, params, aux, key)
-            # account AFTER the dispatch succeeded: a failed first
-            # dispatch must not mark the bucket warm (warmup() would
-            # skip it) or count a compile that never finished
-            with self._lock:
-                if compile_key not in self._compiled:
-                    self._compiled.add(compile_key)
-                    _COMPILES.inc(engine=self.name, bucket=str(bucket))
-        elif outs is None:
-            outs = self._jit(data, params, aux, key)
         keep = None if n == bucket else n
         result = [NDArray(o[:keep] if keep is not None else o)
                   for o in outs]

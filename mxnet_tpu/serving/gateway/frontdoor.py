@@ -52,8 +52,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ...base import MXNetError, getenv
+from ...observability import httpz as _httpz
 from ...observability import registry as _obs
 from ...observability import telemetry as _telemetry
+from ...observability import trace as _trace
 from ...resilience import (Deadline, DeadlineExceeded, InjectedFailure,
                            InjectedFault, chaos_point)
 from ...resilience import lease as _lease
@@ -205,6 +207,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        tp = getattr(self, "_traceparent", None)
+        if tp:
+            # echo the request's trace identity (incoming traceparent
+            # or the fresh root minted at admission) so the caller can
+            # join its logs to the merged trace
+            self.send_header("traceparent", tp)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code, text, ctype="text/plain; version=0.0.4"):
+        body = text.encode("utf-8")
+        self._responded = True
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
@@ -229,6 +246,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ----------------------------------------------------------
     def do_GET(self):
+        # GETs are untraced: a keep-alive connection interleaving a
+        # GET after a traced POST must not echo the stale identity
+        self._traceparent = None
         gw = self.gateway
         if self.path == "/healthz":
             ok = not gw.closing
@@ -248,14 +268,26 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/v1/models":
             self._send_json(200, {"models": gw.registry.stats()})
             return
+        if self.path == "/metricsz":
+            # the Prometheus scrape surface: every process-wide
+            # counter/gauge/histogram in exposition text format
+            self._send_text(200, _obs.REGISTRY.to_prometheus())
+            return
+        if self.path == "/debugz":
+            self._send_text(
+                200, json.dumps(gw.debug_state(), default=str,
+                                sort_keys=True),
+                ctype="application/json")
+            return
         self._send_json(404, {"error": "no route %r" % self.path})
 
     def do_POST(self):
         # per-REQUEST response marker: the handler instance persists
         # across requests on one keep-alive connection, so a stale
         # True from the previous request would misroute this one's
-        # last-resort error mapping
+        # last-resort error mapping (same for the echoed traceparent)
         self._responded = False
+        self._traceparent = None
         if self.headers.get("Transfer-Encoding"):
             # a chunked body can't be drained by Content-Length; left
             # unread it would poison this keep-alive connection, so
@@ -432,19 +464,49 @@ class Gateway:
             "registry": self.registry.stats(),
         }
 
+    def debug_state(self):
+        """The `/debugz` payload: the process-wide snapshot (lease
+        holder, compile/AOT counters, trace plane, thread stacks)
+        plus the gateway's own live state — per-class queue depths
+        and grants, resident models with measured device bytes, and
+        per-model server stats (decode slot occupancy included)."""
+        return _httpz.debug_snapshot(extra={
+            "gateway": {
+                "url": self.url if self._started else None,
+                "ready": self.ready(),
+                "closing": self.closing,
+                "concurrency": self._admission.concurrency,
+                "queues": self._admission.queue_depths(),
+                "granted": dict(self._admission.granted),
+                "shed": dict(self._admission.shed),
+            },
+            "registry": self.registry.stats(),
+            "servers": self.registry.server_states(),
+        })
+
     # ------------------------------------------------------------------
     # request path (runs on handler threads)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _cur_trace_id():
+        """Trace id of the active (sampled) request context, or None —
+        the exemplar tag and the per-record correlation key."""
+        ctx = _trace.current()
+        return ctx.trace_id if ctx is not None and ctx.sampled else None
+
     def _observe(self, event, model, cls, route, status, t0,
                  queue_s=None, reason=None, tokens=None):
         dt = time.perf_counter() - t0
+        trace_id = self._cur_trace_id()
         if event == "request":
             # SERVED requests only: the per-class latency percentiles
             # are the SLO surface perf_gate budgets — fast 404s or
             # arbitrary-latency 500s must not dilute them (they ride
-            # event="error" records instead)
+            # event="error" records instead). The worst-K latencies
+            # keep their trace ids as exemplars, so a p99 breach names
+            # concrete traceable requests
             _REQUESTS.inc(**{"model": model, "class": cls})
-            _LATENCY.observe(dt, **{"class": cls})
+            _LATENCY.observe(dt, exemplar=trace_id, **{"class": cls})
         elif event == "shed":
             _SHED.inc(**{"model": model, "class": cls,
                          "reason": reason or "?"})
@@ -458,6 +520,8 @@ class Gateway:
                 rec["reason"] = reason
             if tokens is not None:
                 rec["tokens"] = tokens
+            if trace_id is not None:
+                rec["trace_id"] = trace_id
             _telemetry.emit(rec)
 
     def _parse_common(self, body):
@@ -496,6 +560,16 @@ class Gateway:
 
     def _serve(self, handler, model, verb, body):
         t0 = time.perf_counter()
+        # request tracing (docs/observability.md "Distributed
+        # tracing"): accept the client's W3C traceparent (malformed =
+        # fresh root), mint a root otherwise, and echo the identity on
+        # every response — including the cheap pre-admission rejections
+        ctx = None
+        if _trace.enabled():
+            ctx = _trace.TraceContext.from_traceparent(
+                handler.headers.get("traceparent")) \
+                or _trace.TraceContext.new()
+            handler._traceparent = ctx.to_traceparent()
         try:
             cls, deadline = self._parse_common(body)
         except (MXNetError, ValueError, TypeError) as err:
@@ -519,54 +593,69 @@ class Gateway:
             handler._send_json(400, {
                 "error": "%s needs %r" % (verb, field), "model": model})
             return
-        try:
-            self._admission.enter(cls, deadline)
-        except DeadlineExceeded as err:
-            self._observe("shed", model, cls, verb, 504, t0,
-                          reason="deadline")
-            handler._send_json(504, {"error": str(err), "model": model,
-                                     "class": cls})
-            return
-        except RequestRejected as err:
-            self._observe("shed", model, cls, verb, 503, t0,
-                          reason="queue_full")
-            handler._send_json(503, {"error": str(err), "model": model,
-                                     "class": cls})
-            return
-        except MXNetError as err:   # chaos gateway.admit
-            # a fault is not load: it rides event="error" so a chaos
-            # drill never reads as phantom overload in the shed counts
-            self._observe("error", model, cls, verb, 500, t0,
-                          reason="fault")
-            handler._send_json(500, {"error": str(err), "model": model,
-                                     "class": cls})
-            return
-        queue_s = time.perf_counter() - t0
-        try:
-            if verb == "predict":
-                self._serve_predict(handler, model, cls, deadline,
-                                    body, t0, queue_s)
-            else:
-                self._serve_generate(handler, model, cls, deadline,
-                                     body, t0, queue_s)
-        except Exception as err:  # noqa: BLE001 — last-resort mapping
-            # nothing in the request path may kill the connection with
-            # no response: malformed payloads (ragged inputs, a
-            # non-numeric max_new_tokens) answer 400, anything else
-            # 500 — unless the response already started (streaming),
-            # where the connection is all we had
-            if not getattr(handler, "_responded", False):
-                code = 400 if isinstance(err, (ValueError, TypeError,
-                                               KeyError)) else 500
-                self._observe("error", model, cls, verb, code, t0,
-                              reason=type(err).__name__)
-                handler._send_json(code, {
-                    "error": "%s: %s" % (type(err).__name__, err),
-                    "model": model})
-            else:
-                raise
-        finally:
-            self._admission.leave()
+        # the root span covers admission wait + compute + respond
+        # (t0 backdates it to receive time); everything submitted
+        # inside — batcher requests, decode prompts — captures this
+        # context and parents its spans to it across the queue hops
+        with _trace.trace_span("gateway.request", ctx=ctx, t0=t0,
+                               model=model, route=verb,
+                               **{"class": cls}):
+            cur = _trace.current()
+            if cur is not None:
+                # re-point the echoed parent id at the root span so
+                # the client's follow-up spans nest under it
+                handler._traceparent = cur.to_traceparent()
+            try:
+                with _trace.trace_span("gateway.admission",
+                                       **{"class": cls}):
+                    self._admission.enter(cls, deadline)
+            except DeadlineExceeded as err:
+                self._observe("shed", model, cls, verb, 504, t0,
+                              reason="deadline")
+                handler._send_json(504, {"error": str(err),
+                                         "model": model, "class": cls})
+                return
+            except RequestRejected as err:
+                self._observe("shed", model, cls, verb, 503, t0,
+                              reason="queue_full")
+                handler._send_json(503, {"error": str(err),
+                                         "model": model, "class": cls})
+                return
+            except MXNetError as err:   # chaos gateway.admit
+                # a fault is not load: it rides event="error" so a
+                # chaos drill never reads as phantom overload in the
+                # shed counts
+                self._observe("error", model, cls, verb, 500, t0,
+                              reason="fault")
+                handler._send_json(500, {"error": str(err),
+                                         "model": model, "class": cls})
+                return
+            queue_s = time.perf_counter() - t0
+            try:
+                if verb == "predict":
+                    self._serve_predict(handler, model, cls, deadline,
+                                        body, t0, queue_s)
+                else:
+                    self._serve_generate(handler, model, cls, deadline,
+                                         body, t0, queue_s)
+            except Exception as err:  # noqa: BLE001 — last-resort map
+                # nothing in the request path may kill the connection
+                # with no response: malformed payloads (ragged inputs,
+                # a non-numeric max_new_tokens) answer 400, anything
+                # else 500 — unless the response already started
+                # (streaming), where the connection is all we had
+                if not getattr(handler, "_responded", False):
+                    code = 400 if isinstance(err, (ValueError, TypeError,
+                                                   KeyError)) else 500
+                    self._observe("error", model, cls, verb, code, t0,
+                                  reason=type(err).__name__)
+                    handler._send_json(code, {
+                        "error": "%s: %s" % (type(err).__name__, err),
+                        "model": model})
+                else:
+                    raise
+            finally:
+                self._admission.leave()
 
     def _serve_predict(self, handler, model, cls, deadline, body, t0,
                        queue_s):
@@ -584,6 +673,9 @@ class Gateway:
             return
         payload = {"model": model, "class": cls,
                    "outputs": [np.asarray(o).tolist() for o in outs]}
+        trace_id = self._cur_trace_id()
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
         self._observe("request", model, cls, "predict", 200, t0,
                       queue_s=queue_s)
         handler._send_json(200, payload)
@@ -619,9 +711,12 @@ class Gateway:
             n = int(np.asarray(toks).size)
             self._observe("request", model, cls, "generate", 200, t0,
                           queue_s=queue_s, tokens=n)
-            handler._send_json(200, {"model": model, "class": cls,
-                                     "tokens":
-                                         np.asarray(toks).tolist()})
+            payload = {"model": model, "class": cls,
+                       "tokens": np.asarray(toks).tolist()}
+            trace_id = self._cur_trace_id()
+            if trace_id is not None:
+                payload["trace_id"] = trace_id
+            handler._send_json(200, payload)
             return
         # streaming: submit, then relay tokens as they land on the
         # handle (the scheduler appends between decode steps) — one
@@ -637,6 +732,8 @@ class Gateway:
             handler.send_response(200)
             handler.send_header("Content-Type", "application/x-ndjson")
             handler.send_header("Transfer-Encoding", "chunked")
+            if getattr(handler, "_traceparent", None):
+                handler.send_header("traceparent", handler._traceparent)
             handler.end_headers()
             while True:
                 done = h.done()
@@ -656,6 +753,12 @@ class Gateway:
             except Exception as err:  # noqa: BLE001 — delivered inline
                 tail = {"error": str(err), "model": model}
                 status = 500
+            trace_id = self._cur_trace_id()
+            if trace_id is not None:
+                # proxies commonly drop unknown response headers: the
+                # tail line carries the id so streaming callers can
+                # still join their logs to the merged trace
+                tail["trace_id"] = trace_id
             handler._chunk((json.dumps(tail) + "\n").encode("utf-8"))
             handler.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):

@@ -399,6 +399,25 @@ class ModelRegistry:
                 "models": entries,
             }
 
+    def server_states(self):
+        """{model: ModelServer.stats()} for every RESIDENT model —
+        the /debugz drill-down: forward servers report worker
+        backlogs, decode servers report per-scheduler slot occupancy
+        (`active_slots` / `max_slots`), queue depths, and eviction
+        counts. Snapshotted outside the registry lock (stats() takes
+        each server's own locks)."""
+        with self._cond:
+            servers = {e.name: e.server
+                       for e in self._entries.values()
+                       if e.state == "resident" and e.server is not None}
+        states = {}
+        for name, server in servers.items():
+            try:
+                states[name] = server.stats()
+            except Exception as err:  # noqa: BLE001 — debug surface
+                states[name] = {"error": str(err)}
+        return states
+
     def drain_all(self, timeout=None):
         """Drain every resident model (gateway shutdown). TERMINAL:
         the registry closes first, so a racing request cannot

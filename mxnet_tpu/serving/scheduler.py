@@ -48,6 +48,8 @@ import numpy as np
 from ..base import MXNetError, getenv
 from ..observability import registry as _obs
 from ..observability import telemetry as _telemetry
+from ..observability import trace as _trace
+from ..observability.span import capture_context, restored
 from ..resilience import DeadlineExceeded, chaos_point
 from .batcher import RequestRejected, ServerClosed
 from .decode import DecodeEngine
@@ -81,8 +83,9 @@ class DecodeRequest:
     which is what serve_bench builds its percentiles from."""
 
     __slots__ = ("tokens", "max_new_tokens", "deadline", "eos_token",
-                 "source", "enqueued_at", "resolved_at", "token_times",
-                 "generated", "slot", "_event", "_outputs", "_error")
+                 "source", "trace", "enqueued_at", "resolved_at",
+                 "token_times", "generated", "slot", "_event",
+                 "_outputs", "_error")
 
     def __init__(self, tokens, max_new_tokens, deadline=None,
                  eos_token=None, source="decode"):
@@ -91,6 +94,10 @@ class DecodeRequest:
         self.deadline = deadline
         self.eos_token = eos_token
         self.source = source
+        # submitting thread's span/trace context: the scheduler loop
+        # restores it around prefill and parents the generation span
+        # to the submitting request (gateway :generate traces)
+        self.trace = capture_context()
         self.enqueued_at = time.perf_counter()
         self.resolved_at = None
         self.token_times = []
@@ -124,6 +131,11 @@ class DecodeRequest:
         self.resolved_at = time.perf_counter()
         self._error = error
         self._event.set()
+
+    def trace_context(self):
+        """The request's sampled `TraceContext`, or None."""
+        ctx = self.trace[1] if self.trace else None
+        return ctx if ctx is not None and ctx.sampled else None
 
     # -- client side ---------------------------------------------------
     def done(self):
@@ -369,7 +381,14 @@ class ContinuousBatchScheduler:
                 return
             slot = engine.free_slots[0]
             try:
-                first = engine.prefill(req.tokens, slot)
+                # prefill runs on the scheduler thread with the
+                # SUBMITTING request's context restored: the prefill
+                # span (and the TraceAnnotation inside the engine)
+                # parent to the request, not to an orphaned root
+                with restored(req.trace), \
+                        _trace.trace_span("decode.prefill", slot=slot,
+                                          tokens=int(req.tokens.size)):
+                    first = engine.prefill(req.tokens, slot)
             except Exception as err:  # noqa: BLE001 — delivered
                 req.reject(err)
                 continue
@@ -377,8 +396,10 @@ class ContinuousBatchScheduler:
             req.push_token(first)
             self._inflight[slot] = req
             self.tokens_out += 1
+            ctx = req.trace_context()
             _TOKENS.inc(engine=engine.name)
-            _TTFT.observe(req.ttft(), engine=engine.name)
+            _TTFT.observe(req.ttft(), engine=engine.name,
+                          exemplar=ctx.trace_id if ctx else None)
             if req.finished(engine):
                 self._retire(slot)
 
@@ -402,9 +423,18 @@ class ContinuousBatchScheduler:
         self.engine.retire(slot)
         self.served += 1
         req.resolve()
+        ctx = req.trace_context()
+        if ctx is not None:
+            # one retroactive span covering the whole generation
+            # (queue + prefill + every decode step it rode), parented
+            # to the submitting request's span
+            _trace.record_span(
+                "decode.generate", ctx, req.enqueued_at,
+                req.resolved_at, tokens=len(req.generated),
+                slot=slot, scheduler=self.name)
         if _telemetry.stream_enabled():
             gaps = np.diff(req.token_times)
-            _telemetry.emit({
+            rec = {
                 "ts": time.time(), "source": "decode",
                 "event": "request",
                 "step_time": req.resolved_at - req.enqueued_at,
@@ -413,7 +443,10 @@ class ContinuousBatchScheduler:
                 "ttft_s": req.ttft(),
                 "intertoken_s": float(gaps.mean()) if gaps.size else 0.0,
                 "scheduler": self.name,
-            })
+            }
+            if ctx is not None:
+                rec["trace_id"] = ctx.trace_id
+            _telemetry.emit(rec)
 
     def _step_once(self):
         t0 = time.perf_counter()

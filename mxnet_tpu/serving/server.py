@@ -34,6 +34,7 @@ from ..compile import aot as _aot
 from ..compile import coldstart as _coldstart
 from ..observability import registry as _obs
 from ..observability import telemetry as _telemetry
+from ..observability import trace as _trace
 from ..resilience import chaos_point
 from ..resilience import lease as _lease
 from .batcher import DynamicBatcher, ServerClosed
@@ -461,6 +462,13 @@ class ModelServer:
         if not batch:
             return
         rows = sum(r.n for r in batch)
+        # the executing thread ATTACHES the first traced request's
+        # context around the engine dispatch so the device work is
+        # TraceAnnotation-keyed by its trace id; every traced request
+        # additionally gets retroactive queue/batch/dispatch spans
+        # below (the batch is shared — the spans are per trace)
+        trace_ctx = next((c for c in (r.trace_context() for r in batch)
+                          if c is not None), None)
         try:
             chaos_point("serving.infer")
             stacked = {
@@ -468,13 +476,16 @@ class ModelServer:
                        else np.concatenate(
                            [r.inputs[name] for r in batch], axis=0))
                 for name in self.engine.data_names}
-            outs = self.engine.infer(stacked, n=rows,
-                                     device=worker.device)
-            # responses are HOST arrays: one device sync per output per
-            # batch, then zero-copy numpy views per request — a jax
-            # slice op per request would hand back the very dispatch
-            # overhead the coalescing just amortized away
-            host = [o.asnumpy() for o in outs]
+            t_disp = time.perf_counter()
+            with _trace.attached(trace_ctx):
+                outs = self.engine.infer(stacked, n=rows,
+                                         device=worker.device)
+                # responses are HOST arrays: one device sync per output
+                # per batch, then zero-copy numpy views per request — a
+                # jax slice op per request would hand back the very
+                # dispatch overhead the coalescing just amortized away
+                host = [o.asnumpy() for o in outs]
+            t_done = time.perf_counter()
         except Exception as err:   # noqa: BLE001 — delivered per request
             for req in batch:
                 req.reject(err)
@@ -484,6 +495,20 @@ class ModelServer:
         for req in batch:
             req.resolve([o[offset:offset + req.n] for o in host])
             offset += req.n
+            ctx = req.trace_context()
+            if ctx is not None:
+                # retroactive spans, parented to the SUBMITTING span
+                # captured at submit() — the thread hops (handler ->
+                # dispatcher -> worker) preserved the chain
+                _trace.record_span(
+                    "serving.queue", ctx, req.enqueued_at, t0)
+                bid = _trace.record_span(
+                    "serving.batch", ctx, t0, t_done,
+                    worker=worker.index, rows=rows,
+                    requests=len(batch), server=self.engine.name)
+                _trace.record_span(
+                    "engine.dispatch", ctx, t_disp, t_done,
+                    parent_id=bid)
         worker.served_requests += len(batch)
         worker.served_batches += 1
         _REQS_SERVED.inc(len(batch))

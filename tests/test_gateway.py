@@ -14,6 +14,7 @@ the `gateway.admit` chaos site fails one request, not the server; and
 import importlib.util
 import json
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -422,7 +423,13 @@ def test_http_generate_stream_and_nonstream_token_identical(gateway):
         lines = [json.loads(l) for l in r.read().decode().splitlines()]
     assert [l["token"] for l in lines if "token" in l] \
         == list(map(int, direct))
-    assert lines[-1] == {"done": True, "tokens": 6}
+    tail = dict(lines[-1])
+    # the tail line also carries the request's trace id (ISSUE 13:
+    # proxies drop unknown headers, so streaming callers join their
+    # logs to the merged trace from the payload)
+    trace_id = tail.pop("trace_id", None)
+    assert trace_id is None or re.fullmatch("[0-9a-f]{32}", trace_id)
+    assert tail == {"done": True, "tokens": 6}
 
 
 def test_http_shed_and_error_paths(gateway):

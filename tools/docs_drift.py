@@ -1,0 +1,128 @@
+"""Fail when docs/observability.md and the emitted metrics drift apart.
+
+    python tools/docs_drift.py            # exit 1 on drift
+    python tools/docs_drift.py --list     # print both sets
+
+Two sources of truth that must agree:
+
+1. **Code**: every literal metric name passed to
+   ``counter("...")`` / ``gauge("...")`` / ``histogram("...")``
+   anywhere under ``mxnet_tpu/``;
+2. **Docs**: the "Currently wired" metric table in
+   ``docs/observability.md`` (first column; ``/ .suffix`` shorthand
+   rows expand against the previous full name — `` `a.b.c` / `.d` ``
+   documents ``a.b.c`` and ``a.b.d``).
+
+A metric emitted but undocumented, or documented but no longer
+emitted, exits 1 naming each offender — wired as a fast test
+(tests/test_tracing.py), so the table cannot rot. Stdlib-only.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(ROOT, "docs", "observability.md")
+SRC = os.path.join(ROOT, "mxnet_tpu")
+
+#: a literal first argument to counter(/gauge(/histogram( — matches
+#: every registration spelling in the tree (`counter(`, `_counter(`,
+#: `_obs.counter(`, `REGISTRY.counter(`) while rejecting lookalikes
+#: (`time.perf_counter(`, `np.histogram(`, `_host_queue_gauge(`); a
+#: dynamically-composed name can't be audited and so isn't allowed by
+#: this gate's grammar (none exist today)
+_EMIT_RE = re.compile(
+    r"(?:(?:_obs|REGISTRY)\.|(?<![A-Za-z0-9_.])_?)"
+    r"(?:counter|gauge|histogram)\(\s*"
+    r"[\"']([a-z][a-z0-9_.]*)[\"']")
+
+_DOC_NAME_RE = re.compile(r"`([a-z0-9_.]+|\.[a-z0-9_.]+)`")
+
+
+def code_metrics(src=SRC):
+    """Every literal metric name registered under mxnet_tpu/."""
+    names = set()
+    for dirpath, dirnames, filenames in os.walk(src):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                text = f.read()
+            for m in _EMIT_RE.finditer(text):
+                name = m.group(1)
+                if "." in name:      # dotted = a metric, not a kwarg
+                    names.add(name)
+    return names
+
+
+def _expand(base, suffix):
+    """`` `a.b.c` / `.d.e` `` shorthand: the suffix's component count
+    replaces the base's trailing components (docs/observability.md
+    table convention)."""
+    parts = suffix.lstrip(".").split(".")
+    return ".".join(base.split(".")[:-len(parts)] + parts)
+
+
+def doc_metrics(doc=DOC):
+    """Metric names from the first column of the wired-metrics table."""
+    with open(doc) as f:
+        lines = f.readlines()
+    names = set()
+    for line in lines:
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 3:
+            continue
+        first = cells[1]
+        base = None
+        for m in _DOC_NAME_RE.finditer(first):
+            token = m.group(1)
+            if token.startswith("."):
+                if base is None:
+                    continue
+                names.add(_expand(base, token))
+            elif "." in token:
+                base = token
+                names.add(token)
+    return names
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Assert docs/observability.md lists exactly the "
+                    "metrics mxnet_tpu/ emits")
+    ap.add_argument("--list", action="store_true",
+                    help="print both name sets and exit 0")
+    args = ap.parse_args(argv)
+    code = code_metrics()
+    docs = doc_metrics()
+    if args.list:
+        print("code (%d):" % len(code))
+        for n in sorted(code):
+            print("  " + n)
+        print("docs (%d):" % len(docs))
+        for n in sorted(docs):
+            print("  " + n)
+        return 0
+    undocumented = sorted(code - docs)
+    stale = sorted(docs - code)
+    for n in undocumented:
+        print("DRIFT undocumented metric: %s (emitted in mxnet_tpu/, "
+              "missing from docs/observability.md)" % n,
+              file=sys.stderr)
+    for n in stale:
+        print("DRIFT stale doc row: %s (documented but no longer "
+              "emitted)" % n, file=sys.stderr)
+    if undocumented or stale:
+        return 1
+    print("docs_drift: %d metrics, docs and code agree" % len(code))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,16 +171,38 @@ def main(argv=None):
 
     checks = evaluate(summary, args)
     breaches = [c for c in checks if not c[3]]
+    exemplars = {}
+    for name, _, _, ok in checks:
+        if ok:
+            continue
+        # a tail breach names concrete traceable requests/steps, not a
+        # bare percentile: gateway records and step records carry
+        # their trace ids (docs/observability.md "Exemplars") — pull
+        # them up with tools/trace_report.py
+        if name.startswith("gateway_") and name.endswith("_p99_ms"):
+            key = "gateway_%s_exemplars" % name[len("gateway_"):
+                                               -len("_p99_ms")]
+        elif name.startswith("step_"):
+            key = "step_time_exemplars"
+        else:
+            continue
+        if summary.get(key):
+            exemplars[name] = summary[key]
     verdict.update(
         ok=not breaches, steps=summary["steps"],
         checks={name: {"observed": obs, "budget": bud, "ok": ok}
                 for name, obs, bud, ok in checks},
         breaches=[name for name, _, _, ok in checks if not ok])
+    if exemplars:
+        verdict["exemplars"] = exemplars
     print(json.dumps(verdict, sort_keys=True))
     for name, obs, bud, ok in breaches:
         print("BREACH %s: observed %s vs budget %s"
               % (name, "%.6g" % obs if obs is not None else "n/a", bud),
               file=sys.stderr)
+        if name in exemplars:
+            print("  exemplar trace(s): %s"
+                  % ", ".join(exemplars[name]), file=sys.stderr)
     return 1 if breaches else 0
 
 

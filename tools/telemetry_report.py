@@ -22,6 +22,27 @@ class ReportError(Exception):
     """Malformed/empty telemetry input (maps to exit code 1)."""
 
 
+#: THE one list of source prefixes excluded from the headline step-time
+#: percentiles and samples/sec. Serving batches, decode steps, gateway
+#: requests, resilience/compile events, and trace spans all describe
+#: service times or recovery budgets, not training steps — blending
+#: them would make the headline meaningless. Every section below
+#: filters by its own exact source; a NEW excluded source is added
+#: here, once (it used to be re-spelled per section).
+EXCLUDED_HEADLINE_SOURCES = ("serving", "decode", "resilience",
+                             "compile", "gateway", "trace")
+
+
+def headline_records(records):
+    """Training-step records only (falls back to everything for a
+    stream that carries no training records at all, e.g. a
+    serving-only file, so the headline is never empty)."""
+    core = [r for r in records
+            if not str(r.get("source", "")).startswith(
+                EXCLUDED_HEADLINE_SOURCES)]
+    return core or records
+
+
 def _percentile(sorted_values, q):
     """Nearest-rank percentile of an already-sorted list, q in [0, 1]."""
     if not sorted_values:
@@ -64,20 +85,22 @@ def load_records(path):
     return records
 
 
+def _worst_exemplars(recs, k=3):
+    """Trace ids of the slowest records that carry one — the names a
+    p99 breach prints instead of a bare percentile."""
+    tagged = [(float(r["step_time"]), str(r["trace_id"]))
+              for r in recs if r.get("trace_id")]
+    tagged.sort(key=lambda p: -p[0])
+    return [tid for _, tid in tagged[:k]]
+
+
 def summarize(records):
-    # serving batch records (source="serving"), decode step/request
-    # records (source="decode"), and resilience events
-    # (source="resilience": lease acquires/takeovers, watchdog trips)
-    # ride the same stream; they describe service times and recovery
-    # budgets, not training steps, and would turn the headline
-    # percentiles and samples/sec into a meaningless blend — their
-    # sections below cover them, the headline covers everything else
-    # (a serving-only file keeps its records)
-    core = [r for r in records
-            if not str(r.get("source", "")).startswith(
-                ("serving", "decode", "resilience", "compile",
-                 "gateway"))] \
-        or records
+    # non-training records ride the same stream (serving batches,
+    # decode steps, gateway requests, resilience/compile events, trace
+    # spans); EXCLUDED_HEADLINE_SOURCES is the single source of truth
+    # for what the headline percentiles skip — their sections below
+    # cover them (a serving-only file keeps its records)
+    core = headline_records(records)
     step_times = sorted(float(r["step_time"]) for r in core)
     total_time = sum(step_times)
     total_samples = sum(int(r.get("batch_size", 0)) for r in core)
@@ -100,6 +123,12 @@ def summarize(records):
     if total_samples and total_time > 0:
         summary["samples"] = total_samples
         summary["samples_per_sec"] = total_samples / total_time
+    # worst-step exemplars: step records carry the step's trace id
+    # (StepTimer), so a step-time budget breach can name the traces
+    # to pull up in tools/trace_report.py
+    step_exemplars = _worst_exemplars(core)
+    if step_exemplars:
+        summary["step_time_exemplars"] = step_exemplars
     # allreduce/bucket section (dist runs; fields absent on
     # single-process records)
     ar_calls = sum(int(r.get("allreduce_calls", 0)) for r in records)
@@ -224,12 +253,18 @@ def summarize(records):
         summary["gateway_models"] = sorted(
             {str(r.get("model", "?")) for r in gw_reqs})
         for cls in sorted({str(r.get("class", "?")) for r in gw_reqs}):
+            cls_reqs = [r for r in gw_reqs if r.get("class") == cls]
             lat = sorted(1000.0 * float(r["step_time"])
-                         for r in gw_reqs if r.get("class") == cls)
+                         for r in cls_reqs)
             summary["gateway_%s_requests" % cls] = len(lat)
             summary["gateway_%s_p50_ms" % cls] = _percentile(lat, 0.50)
             summary["gateway_%s_p95_ms" % cls] = _percentile(lat, 0.95)
             summary["gateway_%s_p99_ms" % cls] = _percentile(lat, 0.99)
+            # trace ids of this class's slowest requests: the p99
+            # exemplars perf_gate prints on a --max-p99-ms-class breach
+            exemplars = _worst_exemplars(cls_reqs)
+            if exemplars:
+                summary["gateway_%s_exemplars" % cls] = exemplars
         shed_by_class = {}
         for r in gw_sheds:
             cls = str(r.get("class", "?"))
@@ -304,6 +339,16 @@ def summarize(records):
                                    for r in cold)
         summary["aot_fallbacks"] = sum(int(r.get("aot_fallbacks", 0))
                                        for r in cold)
+    # trace section (docs/observability.md "Distributed tracing"):
+    # span records usually live in their own per-rank shard files
+    # (merge with tools/trace_report.py), but a stream that mixes them
+    # in is summarized here — and excluded from the headline, exactly
+    # once, via EXCLUDED_HEADLINE_SOURCES
+    tr = [r for r in records if r.get("source") == "trace"
+          and r.get("event") == "span"]
+    if tr:
+        summary["trace_spans"] = len(tr)
+        summary["trace_traces"] = len({r.get("trace_id") for r in tr})
     # lease/watchdog section (docs/fault_tolerance.md): DeviceLease and
     # HealthWatchdog emit source="resilience" events — step_time is the
     # event's duration (acquire wait, takeover time, tripped budget)
@@ -482,6 +527,13 @@ def format_summary(s):
                 % (s["cold_starts"], s["cold_start_p50_s"],
                    s["cold_start_max_s"], s["cold_start_compile_s"],
                    s.get("aot_loads", 0), s.get("aot_fallbacks", 0)))
+    if "trace_spans" in s:
+        lines.append("  traces      %d span(s) across %d trace(s) — "
+                     "merge shards with tools/trace_report.py"
+                     % (s["trace_spans"], s["trace_traces"]))
+    if "step_time_exemplars" in s:
+        lines.append("  exemplars   slowest steps: %s"
+                     % ", ".join(s["step_time_exemplars"]))
     if "lease_acquires" in s or "watchdog_trips" in s:
         lines.append(
             "  lease       %d acquires (p95 %.4fs)  %d takeovers%s"

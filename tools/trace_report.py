@@ -1,0 +1,271 @@
+"""Merge per-rank trace shards into one Perfetto/chrome trace.
+
+    python tools/trace_report.py <gang-or-trace-dir> --out merged.json
+    python tools/trace_report.py shard0.jsonl shard1.jsonl
+    python tools/trace_report.py <dir> --trace <id>
+
+Each rank writes span records to ``trace_rank_<r>.jsonl``
+(observability/trace.py) under ``MXTPU_TRACE_DIR`` /
+``MXTPU_GANG_DIR``. This tool merges them into ONE timeline:
+
+- ``--out`` writes a chrome-trace JSON (open in chrome://tracing or
+  https://ui.perfetto.dev): one process lane per rank, span args carry
+  trace/span/parent ids, so a request or training step is one
+  connected tree across every rank and thread it touched;
+- **clock alignment**: per-rank wall-clock offsets are estimated from
+  the supervisor's view of the rank heartbeats — each ``rank_<r>.hb``
+  carries the rank's own wall stamp, and the file's mtime is the
+  shared filesystem's (i.e. the supervisor host's) clock observing
+  that write, so ``mtime - stamp`` estimates the rank's skew (≈0 on
+  one host; ``--no-align`` disables);
+- the printed report groups spans by trace id and summarizes each
+  trace's **critical path** — the dominant-child chain from the
+  slowest root — so "which phase ate step 17" or "where did this
+  request stall" is one line, per step, per rank;
+- step traces (deterministic ids across ranks) merge every rank's
+  spans under one id: the per-step line lists all participating ranks
+  and the slowest rank's chain.
+
+Exit codes: 0 ok; 1 no spans / unreadable input (same strictness as
+telemetry_report: a report over garbage is no report). Stdlib-only.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+class TraceReportError(Exception):
+    """No usable spans (maps to exit code 1)."""
+
+
+def _shard_files(paths):
+    """Expand dir arguments into their trace_rank_*.jsonl shards."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p,
+                                                  "trace_rank_*.jsonl")))
+            if not found:
+                raise TraceReportError("no trace_rank_*.jsonl shards "
+                                       "in %s" % p)
+            files.extend(found)
+        else:
+            files.append(p)
+    if not files:
+        raise TraceReportError("no input shards")
+    return files
+
+
+def rank_offsets(dirs):
+    """{rank: wall-clock offset seconds} estimated from heartbeat
+    files: offset = hb file mtime (shared-FS / supervisor clock) -
+    the rank's own recorded wall stamp. Missing/torn heartbeats mean
+    offset 0 for that rank (same-host gangs are ~0 anyway)."""
+    offsets = {}
+    for d in dirs:
+        for path in glob.glob(os.path.join(d, "rank_*.hb")):
+            try:
+                with open(path) as f:
+                    rec = json.loads(f.read())
+                stamp = float(rec["heartbeat"])
+                rank = int(rec["rank"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+            try:
+                offsets[rank] = os.stat(path).st_mtime - stamp
+            except OSError:
+                continue
+    return offsets
+
+
+def load_spans(files, offsets=None):
+    """Parse span records from the shards, clock-aligned. Tolerates
+    blank lines and a torn LAST line per shard (a rank killed mid-
+    write); anything else malformed raises."""
+    offsets = offsets or {}
+    spans = []
+    for path in files:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError as err:
+            raise TraceReportError("cannot read %s: %s" % (path, err))
+        for lineno, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if lineno == len(lines):
+                    continue    # torn tail: the writer died mid-span
+                raise TraceReportError("%s:%d: malformed JSON"
+                                       % (path, lineno))
+            if not isinstance(rec, dict) \
+                    or rec.get("event") != "span":
+                continue        # clock records, foreign lines
+            rank = int(rec.get("rank", 0))
+            rec["ts"] = float(rec["ts"]) + offsets.get(rank, 0.0)
+            rec["dur"] = float(rec.get("step_time", 0.0))
+            spans.append(rec)
+    if not spans:
+        raise TraceReportError("no span records in %s"
+                               % ", ".join(files))
+    return spans
+
+
+def to_chrome_trace(spans):
+    """Chrome-trace JSON dict: pid = rank, tid preserved, µs since the
+    earliest span."""
+    base = min(s["ts"] for s in spans)
+    ranks = sorted({int(s.get("rank", 0)) for s in spans})
+    events = [{"name": "process_name", "ph": "M", "pid": r,
+               "args": {"name": "rank %d" % r}} for r in ranks]
+    for s in spans:
+        events.append({
+            "name": s.get("name", "?"), "ph": "X", "cat": "trace",
+            "ts": (s["ts"] - base) * 1e6, "dur": s["dur"] * 1e6,
+            "pid": int(s.get("rank", 0)),
+            "tid": int(s.get("tid", 0)),
+            "args": {k: v for k, v in s.items()
+                     if k in ("trace_id", "span_id", "parent_id",
+                              "step", "source", "model", "class",
+                              "keys", "bytes", "bucket", "slot",
+                              "tokens", "worker", "server", "error")},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _children(spans):
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent_id"), []).append(s)
+    return by_parent
+
+
+def critical_path(root, by_parent):
+    """Dominant-child chain from `root`: at each level descend into
+    the longest child. Returns [(span, dur), ...] root first."""
+    path = [root]
+    node = root
+    seen = {root.get("span_id")}
+    while True:
+        kids = by_parent.get(node.get("span_id")) or []
+        kids = [k for k in kids if k.get("span_id") not in seen]
+        if not kids:
+            return path
+        node = max(kids, key=lambda k: k["dur"])
+        seen.add(node.get("span_id"))
+        path.append(node)
+
+
+def summarize(spans):
+    """[{trace_id, name, dur, spans, ranks, critical, step?}] per
+    trace, ordered by start time."""
+    traces = {}
+    for s in spans:
+        traces.setdefault(s.get("trace_id", "?"), []).append(s)
+    out = []
+    for tid, group in traces.items():
+        ids = {s.get("span_id") for s in group}
+        roots = [s for s in group
+                 if not s.get("parent_id")
+                 or s.get("parent_id") not in ids]
+        if not roots:
+            roots = [min(group, key=lambda s: s["ts"])]
+        by_parent = _children(group)
+        slowest = max(roots, key=lambda s: s["dur"])
+        chain = critical_path(slowest, by_parent)
+        total = slowest["dur"] or 1e-12
+        entry = {
+            "trace_id": tid,
+            "name": slowest.get("name", "?"),
+            "start_ts": min(s["ts"] for s in group),
+            "dur_s": slowest["dur"],
+            "spans": len(group),
+            "roots": len(roots),
+            "ranks": sorted({int(s.get("rank", 0)) for s in group}),
+            "critical": [
+                {"name": s.get("name", "?"), "dur_s": s["dur"],
+                 "pct": 100.0 * s["dur"] / total,
+                 "rank": int(s.get("rank", 0))}
+                for s in chain],
+        }
+        if slowest.get("step") is not None:
+            entry["step"] = slowest["step"]
+        if slowest.get("source") is not None:
+            entry["source"] = slowest["source"]
+        out.append(entry)
+    out.sort(key=lambda e: e["start_ts"])
+    return out
+
+
+def format_report(entries):
+    lines = ["trace report (%d trace(s))" % len(entries)]
+    for e in entries:
+        head = e["name"]
+        if "step" in e:
+            head = "step %s [%s]" % (e["step"], e.get("source", "?"))
+        ranks = ",".join(str(r) for r in e["ranks"])
+        lines.append(
+            "  %s  %s  %.4fs  %d span(s)  rank(s) %s"
+            % (e["trace_id"][:16], head, e["dur_s"], e["spans"], ranks))
+        chain = e["critical"][1:]   # the root itself is the header
+        if chain:
+            lines.append(
+                "      critical: "
+                + " > ".join("%s %.1f%% (%.4fs)"
+                             % (c["name"], c["pct"], c["dur_s"])
+                             for c in chain))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank trace shards into one chrome "
+                    "trace + critical-path report")
+    ap.add_argument("paths", nargs="+",
+                    help="trace/gang directory or shard file(s)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged chrome-trace JSON here")
+    ap.add_argument("--trace", default=None,
+                    help="only this trace id")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON lines")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip heartbeat-based clock alignment")
+    args = ap.parse_args(argv)
+    try:
+        files = _shard_files(args.paths)
+        dirs = {os.path.dirname(os.path.abspath(f)) for f in files} \
+            | {p for p in args.paths if os.path.isdir(p)}
+        offsets = {} if args.no_align else rank_offsets(sorted(dirs))
+        spans = load_spans(files, offsets)
+    except TraceReportError as err:
+        print("trace_report: %s" % err, file=sys.stderr)
+        return 1
+    if args.trace:
+        spans = [s for s in spans if s.get("trace_id") == args.trace]
+        if not spans:
+            print("trace_report: no spans for trace %s" % args.trace,
+                  file=sys.stderr)
+            return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(to_chrome_trace(spans), f)
+        print("wrote %s (%d spans)" % (args.out, len(spans)))
+    entries = summarize(spans)
+    if args.json:
+        for e in entries:
+            print(json.dumps(e, sort_keys=True))
+    else:
+        print(format_report(entries))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
