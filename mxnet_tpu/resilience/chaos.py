@@ -11,6 +11,12 @@ Fields per site:
   kind   raise  -> InjectedFault (a TransientError: retry-safe)
          fatal  -> InjectedFailure (never retried)
          sleep  -> time.sleep(secs) (exercises deadlines)
+         hang   -> time.sleep(secs, default 3600) — the wedged-device
+                   simulation: a dispatch that never returns on its
+                   own. Only a watchdog deadline (or the chaos_run
+                   reaper) bounds it; the serving resilience plane's
+                   `engine.dispatch` / `serving.replica<k>.dispatch`
+                   sites are its home
          kill   -> SIGKILL this process (the rank-death chaos mode —
                    no cleanup, no atexit: exactly what a preempted VM
                    or an OOM kill looks like to the gang)
@@ -42,7 +48,13 @@ step boundary — `resilience.preempt.at_step_boundary` — so `kind=kill`
 kills a rank mid-run), `engine.host_push`, `serving.infer`,
 `serving.decode` (fires before every continuous-batching decode step;
 kind=sleep stretches steps so deadline eviction can be exercised,
-kind=raise fails every in-flight sequence), `gateway.admit` (on every
+kind=raise fails every in-flight sequence), `engine.dispatch` (inside
+every watchdog-guarded serving dispatch — forward batches, decode
+prefill/step; kind=hang is the wedged-device drill the dispatch
+watchdog bounds) plus its replica-addressed twins
+`serving.replica<k>.dispatch` (fired by ModelServer worker `k` and its
+canary probe, so a chaos run can wedge ONE replica of N —
+tools/chaos_run.py ``--wedge-replica``), `gateway.admit` (on every
 gateway admission attempt, before the priority queues — a tripped
 fault is one 500 response, the gateway keeps serving), `lease.acquire`
 (before a
@@ -82,7 +94,7 @@ class InjectedFailure(MXNetError):
 
 
 _FIELDS = {"p": float, "secs": float, "n": int, "after": int, "kind": str}
-_KINDS = ("raise", "fatal", "sleep", "kill", "nan", "bitflip")
+_KINDS = ("raise", "fatal", "sleep", "hang", "kill", "nan", "bitflip")
 # kinds that mutate an ARRAY at a corrupt_point instead of raising at a
 # chaos_point: kind=nan poisons one element (caught by the numerics
 # guard's in-graph isfinite check -> the skip path), kind=bitflip flips
@@ -132,7 +144,11 @@ class _Site:
         self.name = name
         self.p = float(fields.get("p", 1.0))
         self.kind = fields.get("kind", "raise")
-        self.secs = float(fields.get("secs", 0.1))
+        # a hang is a sleep that never ends on its own: the default
+        # dwarfs every deadline in the system, so only a watchdog (or
+        # the chaos_run reaper) unwedges the caller
+        self.secs = float(fields.get(
+            "secs", 3600.0 if self.kind == "hang" else 0.1))
         self.n = fields.get("n")
         self.after = int(fields.get("after", 0))
         self.rng = random.Random("%s:%s" % (seed, name))
@@ -154,7 +170,7 @@ class _Site:
             return None
         self.trips += 1
         metrics.bump("chaos.injected.%s" % at_site)
-        if self.kind == "sleep":
+        if self.kind in ("sleep", "hang"):
             return self.secs
         if self.kind == "kill":
             return _KILL

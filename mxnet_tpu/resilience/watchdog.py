@@ -21,10 +21,20 @@ Every trip is counted (`resilience.watchdog.trips{kind=...}`) and, when
 telemetry file alone (tools/telemetry_report.py's lease/watchdog
 section).
 
+* `guard_dispatch()` bounds one SERVING engine dispatch (ISSUE 14,
+  docs/fault_tolerance.md "Serving resilience"): a wedged XLA dispatch
+  trips as a typed `DeviceUnreachable` in bounded time — the replica
+  health machinery in `serving.server`/`serving.scheduler` quarantines
+  the replica instead of letting every request on it hang forever.
+
 Env knobs (docs/fault_tolerance.md):
   MXTPU_WATCHDOG_INIT_S        device-init deadline (180; 0 disables)
   MXTPU_WATCHDOG_COLLECTIVE_S  default collective deadline when the
                                call site doesn't pass one (0 = off)
+  MXTPU_SERVE_DISPATCH_TIMEOUT_S
+                               serving-dispatch deadline (0 = off; the
+                               default — the watchdog-off path is the
+                               plain direct call, bit-identical)
 """
 from __future__ import annotations
 
@@ -42,7 +52,8 @@ __all__ = ["DeviceUnreachable", "HealthWatchdog", "diagnostics"]
 
 TRIPS = _obs.counter(
     "resilience.watchdog.trips",
-    "Watchdog deadline trips (label kind: init / collective)")
+    "Watchdog deadline trips (label kind: init / collective / "
+    "dispatch)")
 
 _log = None
 
@@ -120,6 +131,8 @@ class HealthWatchdog:
         self.collective_timeout_s = float(
             collective_timeout_s if collective_timeout_s is not None
             else getenv("MXTPU_WATCHDOG_COLLECTIVE_S", 0.0))
+        self.dispatch_timeout_s = float(
+            getenv("MXTPU_SERVE_DISPATCH_TIMEOUT_S", 0.0))
         self.lease_path = lease_path
         # persistent guard worker (peer-checked collectives run every
         # bucket through here — a fresh thread per call would tax the
@@ -226,6 +239,36 @@ class HealthWatchdog:
         coordinator."""
         return self._guard(fn, what, timeout_s, self.init_timeout_s,
                            "init", peer_check=peer_check)
+
+    def guard_dispatch(self, fn, what="engine dispatch",
+                       timeout_s=None):
+        """Run one serving engine dispatch under a deadline; a trip
+        raises a typed `DeviceUnreachable` (kind=dispatch) with holder
+        diagnostics — the wedged-device signal the serving replica
+        health machinery quarantines on. `timeout_s` None falls back
+        to ``MXTPU_SERVE_DISPATCH_TIMEOUT_S``; <= 0 means unguarded:
+        the plain direct call, bit-identical to the pre-watchdog path.
+
+        Same execution shape as `guard_collective`: the dispatch runs
+        on the persistent daemon guard worker (a wedged XLA call
+        cannot be cancelled from Python — it keeps blocking its
+        thread, and later guards fall back to ephemeral threads while
+        the worker is held)."""
+        t = float(timeout_s if timeout_s is not None
+                  else self.dispatch_timeout_s)
+        if t <= 0:
+            return fn()
+        box, done = self._submit(fn, what)
+        if not done.wait(timeout=t):
+            diag = self._trip("dispatch", what, t)
+            raise DeviceUnreachable(
+                "%s did not complete within %.6gs — the device "
+                "dispatch is wedged (the call still blocks a daemon "
+                "thread; see docs/fault_tolerance.md \"Serving "
+                "resilience\")" % (what, t), diag)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
 
     def _guard(self, fn, what, timeout_s, default_t, kind,
                peer_check=None):

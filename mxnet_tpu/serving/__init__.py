@@ -43,9 +43,23 @@ The front door sits on top (ISSUE-12):
              stdlib HTTP server with interactive|batch|best_effort
              priority-class admission and deadline-aware shedding).
 
+The resilience plane rides every layer (ISSUE-14,
+docs/fault_tolerance.md "Serving resilience"):
+
+- `health`: watchdog-bounded dispatch
+             (``MXTPU_SERVE_DISPATCH_TIMEOUT_S``; a wedged XLA call
+             trips as a typed `DeviceUnreachable` in bounded time),
+             the replica health state machine (healthy → quarantined
+             → canary-re-admitted; dead workers/schedulers stop
+             receiving traffic and their queues re-dispatch), the
+             per-model gateway circuit breaker (`BreakerOpen`,
+             instant 503 + Retry-After), and hedged interactive
+             requests (``MXTPU_GATEWAY_HEDGE_MS``, off by default).
+
 `c_predict.Predictor` and `Module.predict` are thin shims over this
 layer (``MXTPU_SERVING_ENGINE=0`` restores the legacy Module path).
-Chaos sites: `serving.infer`, `serving.decode`, `gateway.admit`.
+Chaos sites: `serving.infer`, `serving.decode`, `gateway.admit`,
+`engine.dispatch` (+ `serving.replica<k>.dispatch`).
 Metrics: `serving.*` in the observability registry; per-batch/per-step
 JSONL records ride the ``MXTPU_TELEMETRY`` stream.
 """
@@ -53,6 +67,8 @@ from .engine import InferenceEngine, bucket_sizes, resolve_serve_dtype
 from .batcher import (DynamicBatcher, InferenceRequest, RequestRejected,
                       ServerClosed)
 from .decode import DecodeEngine
+from .health import (BreakerOpen, DeviceUnreachable, NoHealthyReplica,
+                     SchedulerCrashed)
 from .scheduler import ContinuousBatchScheduler, DecodeRequest
 from .server import ModelServer
 from .gateway import Gateway, ModelRegistry, PRIORITY_CLASSES
@@ -61,4 +77,5 @@ __all__ = ["InferenceEngine", "bucket_sizes", "resolve_serve_dtype",
            "DynamicBatcher", "InferenceRequest", "RequestRejected",
            "ServerClosed", "DecodeEngine", "ContinuousBatchScheduler",
            "DecodeRequest", "ModelServer", "Gateway", "ModelRegistry",
-           "PRIORITY_CLASSES"]
+           "PRIORITY_CLASSES", "BreakerOpen", "DeviceUnreachable",
+           "NoHealthyReplica", "SchedulerCrashed"]

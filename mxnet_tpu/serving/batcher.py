@@ -89,13 +89,16 @@ class InferenceRequest:
     dispatch overhead coalescing amortized)."""
 
     __slots__ = ("inputs", "n", "deadline", "source", "trace",
-                 "enqueued_at", "resolved_at", "_event", "_outputs",
-                 "_error")
+                 "enqueued_at", "resolved_at", "attempts", "_event",
+                 "_outputs", "_error")
 
     def __init__(self, inputs, n, deadline=None, source="default"):
         self.inputs = inputs
         self.n = int(n)
         self.deadline = deadline
+        self.attempts = 0     # replica re-dispatches after a wedge
+        #                       (capped — docs/fault_tolerance.md
+        #                       "Serving resilience")
         self.source = source      # owning batcher/server, the latency
         #                           histogram label — two servers in
         #                           one process must not blend tails

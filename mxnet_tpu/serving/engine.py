@@ -696,6 +696,18 @@ class InferenceEngine:
                                engine=self.name)
         return result
 
+    def zero_inputs(self, n=1):
+        """A zero-filled request batch of `n` rows (static inputs at
+        their fixed shapes) — the warmup payload, and the canary-probe
+        dispatch the serving replica health machinery uses to re-admit
+        a quarantined replica (docs/fault_tolerance.md "Serving
+        resilience")."""
+        out = {name: np.zeros((n,) + shape, dtype)
+               for name, shape, dtype in self._descs}
+        out.update((name, np.zeros(shape, dtype))
+                   for name, (shape, dtype) in self._static_descs.items())
+        return out
+
     def warmup(self, buckets=None, device=None):
         """Precompile the padding buckets (all of them by default) with
         zero batches, so the first real request never pays an XLA
@@ -703,8 +715,6 @@ class InferenceEngine:
         list of bucket sizes warmed."""
         warmed = []
         devkey = None if device is None else device.id
-        statics = {name: np.zeros(shape, dtype)
-                   for name, (shape, dtype) in self._static_descs.items()}
         if buckets is None:
             # a static-only model has ONE program (no padded batch
             # axis); its single "bucket" is the declared size
@@ -716,9 +726,6 @@ class InferenceEngine:
                 seen = (b, devkey) in self._compiled
             if seen:
                 continue
-            zeros = {name: np.zeros((b,) + shape, dtype)
-                     for name, shape, dtype in self._descs}
-            zeros.update(statics)
-            self.infer(zeros, n=b, device=device)
+            self.infer(self.zero_inputs(b), n=b, device=device)
             warmed.append(b)
         return warmed

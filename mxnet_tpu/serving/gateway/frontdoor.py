@@ -44,6 +44,7 @@ the registry's ``reload``/``resident`` family.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from collections import deque
@@ -59,7 +60,10 @@ from ...observability import trace as _trace
 from ...resilience import (Deadline, DeadlineExceeded, InjectedFailure,
                            InjectedFault, chaos_point)
 from ...resilience import lease as _lease
+from .. import health as _health
 from ..batcher import RequestRejected, ServerClosed
+from ..health import (BreakerOpen, DeviceUnreachable, NoHealthyReplica,
+                      SchedulerCrashed)
 from .registry import ModelRegistry
 
 __all__ = ["Gateway", "PRIORITY_CLASSES"]
@@ -201,12 +205,17 @@ class _Handler(BaseHTTPRequestHandler):
     def gateway(self):
         return self.server.gateway
 
-    def _send_json(self, code, payload):
+    def _send_json(self, code, payload, retry_after=None):
         body = json.dumps(payload).encode("utf-8")
         self._responded = True
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            # the backpressure signal shed responses carry: closed-loop
+            # clients (serve_bench) and real callers back off instead
+            # of retry-storming an overloaded or breaker-open model
+            self.send_header("Retry-After", str(int(retry_after)))
         tp = getattr(self, "_traceparent", None)
         if tp:
             # echo the request's trace identity (incoming traceparent
@@ -367,6 +376,12 @@ class Gateway:
         self._started = False
         self.closing = False
         self._leased = False
+        # per-class EWMA of served-request latency: the service-rate
+        # half of the Retry-After derivation (queue depth is the other)
+        # — mutated from concurrent handler threads under _stats_lock
+        self._stats_lock = threading.Lock()
+        self._svc_ewma = {}
+        self.hedges = {"fired": 0, "won": 0}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -461,6 +476,7 @@ class Gateway:
             "queues": self._admission.queue_depths(),
             "granted": dict(self._admission.granted),
             "shed": dict(self._admission.shed),
+            "hedges": dict(self.hedges),
             "registry": self.registry.stats(),
         }
 
@@ -479,6 +495,7 @@ class Gateway:
                 "queues": self._admission.queue_depths(),
                 "granted": dict(self._admission.granted),
                 "shed": dict(self._admission.shed),
+                "hedges": dict(self.hedges),
             },
             "registry": self.registry.stats(),
             "servers": self.registry.server_states(),
@@ -499,6 +516,10 @@ class Gateway:
         dt = time.perf_counter() - t0
         trace_id = self._cur_trace_id()
         if event == "request":
+            with self._stats_lock:
+                prev = self._svc_ewma.get(cls)
+                self._svc_ewma[cls] = dt if prev is None \
+                    else 0.8 * prev + 0.2 * dt
             # SERVED requests only: the per-class latency percentiles
             # are the SLO surface perf_gate budgets — fast 404s or
             # arbitrary-latency 500s must not dilute them (they ride
@@ -536,7 +557,19 @@ class Gateway:
                                 what="gateway request")
         return cls, deadline
 
-    def _submit_with_retry(self, model, submit):
+    def _retry_after(self, cls):
+        """The `Retry-After` seconds a shed response carries: class
+        queue depth × recent service time / compute slots — how long
+        the backlog ahead actually takes to clear — clamped to [1, 30]
+        whole seconds (1 when nothing has been served yet)."""
+        ewma = self._svc_ewma.get(cls)
+        if not ewma:
+            return 1
+        depth = self._admission.queue_depths().get(cls, 0)
+        est = (depth + 1) * ewma / self._admission.concurrency
+        return max(1, min(30, int(math.ceil(est))))
+
+    def _submit_with_retry(self, model, submit, count=True):
         """registry.get + submit, retrying ONCE through the registry
         when an in-progress eviction raced us to the server (the retry
         reloads transparently). The model-named ServerClosed from the
@@ -544,8 +577,9 @@ class Gateway:
         handle."""
         for attempt in (0, 1):
             # the retry is the SAME client request: count it once
-            server = self.registry.get(model,
-                                       _count_request=(attempt == 0))
+            # (hedge duplicates pass count=False — one client request)
+            server = self.registry.get(
+                model, _count_request=(attempt == 0 and count))
             try:
                 return submit(server)
             except ServerClosed:
@@ -553,10 +587,104 @@ class Gateway:
                     raise
         raise AssertionError("unreachable")
 
-    def _resolve(self, model, submit, deadline):
-        """`_submit_with_retry` + block for the result."""
+    def _hedge_delay_s(self, cls):
+        """The hedge delay for this request, in seconds, or None when
+        hedging does not apply (off by default; interactive class
+        only). ``MXTPU_GATEWAY_HEDGE_MS=auto`` derives it from the
+        observed interactive p95 — the classic tail-at-scale policy:
+        hedge only the slowest ~5%."""
+        if cls != "interactive":
+            return None
+        ms = _health.hedge_delay_ms()
+        if ms is None:
+            return None
+        if ms == "auto":
+            p95 = _LATENCY.percentile(0.95, **{"class": "interactive"})
+            return float(p95) if p95 and p95 > 0 else None
+        return float(ms) / 1000.0
+
+    def _resolve(self, model, submit, deadline, cls=None):
+        """`_submit_with_retry` + block for the result; an interactive
+        request still unresolved after the hedge delay is duplicated
+        to another replica (first success wins, the loser's result is
+        discarded)."""
         timeout = deadline.remaining() if deadline is not None else 600.0
-        return self._submit_with_retry(model, submit).result(timeout)
+        handle = self._submit_with_retry(model, submit)
+        hedge_s = self._hedge_delay_s(cls)
+        if hedge_s is None:
+            return handle.result(timeout)
+        return self._hedged_result(model, submit, handle, hedge_s,
+                                   timeout)
+
+    def _hedged_result(self, model, submit, h1, hedge_s, timeout):
+        t_end = time.perf_counter() + max(0.0, timeout)
+        wait = min(hedge_s, max(0.0, t_end - time.perf_counter()))
+        if h1._event.wait(wait):
+            return h1.result(0.0)
+        if time.perf_counter() >= t_end - 0.001:
+            # the request's own budget is (as good as) gone: a
+            # duplicate could never answer in time — don't burn
+            # compute or inflate the hedge counters for it
+            return h1.result(0.0)
+        # the primary is past the hedge delay: fire the duplicate
+        # (best-effort — a shed duplicate must never fail the
+        # original), then first SUCCESS wins
+        _health.HEDGE_FIRED.inc(model=model)
+        with self._stats_lock:
+            self.hedges["fired"] += 1
+        try:
+            h2 = self._submit_with_retry(model, submit, count=False)
+        except Exception:  # noqa: BLE001 — opportunistic only
+            h2 = None
+        if h2 is None:
+            # fired-but-unplaceable still leaves its telemetry record
+            # (the event count must mirror serving.hedge.fired)
+            _health.emit_event("hedge", model=str(model), won=False)
+            return h1.result(max(0.0, t_end - time.perf_counter()))
+        pending, errors = [h1, h2], []
+        won = False
+
+        def discard(losers):
+            # the loser's compute is abandoned: a decode handle frees
+            # its KV slot at the next step boundary instead of
+            # generating to max_new_tokens for nobody (forward handles
+            # have nothing to cancel — their batch runs either way)
+            for h in losers:
+                cancel = getattr(h, "cancel", None)
+                if cancel is not None and not h.done():
+                    cancel()
+
+        try:
+            while pending and time.perf_counter() < t_end:
+                for h in list(pending):
+                    if not h.done():
+                        continue
+                    pending.remove(h)
+                    try:
+                        out = h.result(0.0)
+                    except Exception as err:  # noqa: BLE001 — kept
+                        errors.append(err)
+                        continue
+                    if h is h2:
+                        won = True
+                        _health.HEDGE_WON.inc(model=model)
+                        with self._stats_lock:
+                            self.hedges["won"] += 1
+                    discard(pending)
+                    return out
+                # event-wait, not a spin: wake the moment a pending
+                # handle resolves (the other is re-checked each slice)
+                if pending:
+                    pending[0]._event.wait(0.005)
+            discard(pending)
+            if errors:
+                raise errors[0]
+            raise DeadlineExceeded(
+                "hedged request for model %r timed out after %.6gs "
+                "(primary and hedge both unresolved)"
+                % (model, timeout))
+        finally:
+            _health.emit_event("hedge", model=str(model), won=won)
 
     def _serve(self, handler, model, verb, body):
         t0 = time.perf_counter()
@@ -613,13 +741,15 @@ class Gateway:
                 self._observe("shed", model, cls, verb, 504, t0,
                               reason="deadline")
                 handler._send_json(504, {"error": str(err),
-                                         "model": model, "class": cls})
+                                         "model": model, "class": cls},
+                                   retry_after=self._retry_after(cls))
                 return
             except RequestRejected as err:
                 self._observe("shed", model, cls, verb, 503, t0,
                               reason="queue_full")
                 handler._send_json(503, {"error": str(err),
-                                         "model": model, "class": cls})
+                                         "model": model, "class": cls},
+                                   retry_after=self._retry_after(cls))
                 return
             except MXNetError as err:   # chaos gateway.admit
                 # a fault is not load: it rides event="error" so a
@@ -667,10 +797,11 @@ class Gateway:
         try:
             outs = self._resolve(
                 model, lambda s: s.submit(inputs, deadline=deadline),
-                deadline)
+                deadline, cls=cls)
         except Exception as err:  # noqa: BLE001 — mapped to status
             self._fail(handler, model, cls, "predict", t0, err)
             return
+        self.registry.record_success(model)
         payload = {"model": model, "class": cls,
                    "outputs": [np.asarray(o).tolist() for o in outs]}
         trace_id = self._cur_trace_id()
@@ -704,10 +835,11 @@ class Gateway:
 
         if not stream:
             try:
-                toks = self._resolve(model, submit, deadline)
+                toks = self._resolve(model, submit, deadline, cls=cls)
             except Exception as err:  # noqa: BLE001
                 self._fail(handler, model, cls, "generate", t0, err)
                 return
+            self.registry.record_success(model)
             n = int(np.asarray(toks).size)
             self._observe("request", model, cls, "generate", 200, t0,
                           queue_s=queue_s, tokens=n)
@@ -750,9 +882,15 @@ class Gateway:
                 h.result(0.001)
                 tail = {"done": True, "tokens": sent}
                 status = 200
+                self.registry.record_success(model)
             except Exception as err:  # noqa: BLE001 — delivered inline
                 tail = {"error": str(err), "model": model}
                 status = 500
+                # mid-stream failures bypass _fail (the response
+                # already started) but must still feed the breaker
+                # with the SAME strike policy
+                if self._breaker_strike(err):
+                    self.registry.record_failure(model, err)
             trace_id = self._cur_trace_id()
             if trace_id is not None:
                 # proxies commonly drop unknown response headers: the
@@ -762,26 +900,84 @@ class Gateway:
             handler._chunk((json.dumps(tail) + "\n").encode("utf-8"))
             handler.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
-            # the client went away (before OR mid-stream): the record
-            # still lands, and the generation itself keeps running to
-            # completion on the scheduler (slot freed at retire)
+            # the client went away (before OR mid-stream): cancel the
+            # generation so its KV slot frees at the next step
+            # boundary instead of leaking compute until max_new_tokens
+            # — and the handler thread survives to serve the next
+            # keep-alive request (the record still lands)
+            h.cancel()
             status = 499
         self._observe("request" if status == 200 else "error",
                       model, cls, "generate", status, t0,
                       queue_s=queue_s, tokens=sent)
 
+    @staticmethod
+    def _breaker_strike(err):
+        """ONE strike policy for every failure-reporting site (_fail
+        and the mid-stream tail): whole-model outages and non-client
+        errors count; replica-scoped wedges, sheds, deadlines, drains
+        and client mistakes (all MXNetError/ValueError/TypeError
+        shapes) do not; a failure the registry already counted at
+        load time (`_mxtpu_breaker_counted`) is never counted
+        twice."""
+        if getattr(err, "_mxtpu_breaker_counted", False):
+            return False
+        if isinstance(err, NoHealthyReplica):
+            # a transient all-quarantined window (canary-recoverable)
+            # is replica-plane weather, not model failure — only an
+            # all-corpses outage strikes
+            return not err.recovering
+        return not isinstance(err, (MXNetError, ValueError, TypeError))
+
     def _fail(self, handler, model, cls, route, t0, err):
         """Map a request-path error to an HTTP status with model
-        attribution, and record it."""
-        if isinstance(err, ServerClosed):
+        attribution, and record it. Server-side failures
+        (`_breaker_strike`) additionally count a breaker strike for
+        the model; shed/backpressure statuses carry a `Retry-After`
+        hint."""
+        retry_after = None
+        if isinstance(err, BreakerOpen):
+            # the circuit breaker's instant 503: no builder was
+            # hammered, no compute happened; Retry-After carries the
+            # cooldown remaining
+            status, reason = 503, "breaker"
+            retry_after = max(1, int(math.ceil(err.retry_after_s
+                                               or 1.0)))
+            payload = {"error": str(err), "model": err.model or model,
+                       "class": cls}
+        elif isinstance(err, (DeviceUnreachable, NoHealthyReplica)):
+            # wedged/unavailable replicas: a server fault worth
+            # backing off from. Only the WHOLE-model outage
+            # (NoHealthyReplica) is a breaker strike — a single
+            # replica's DeviceUnreachable is replica-scoped and
+            # already handled by quarantine; one wedged step failing
+            # N in-flight requests must not open the model's breaker
+            # while healthy replicas survive
+            status, reason = 503, "unhealthy"
+            retry_after = self._retry_after(cls)
+            payload = {"error": str(err),
+                       "model": getattr(err, "server", None) or model,
+                       "class": cls}
+        elif isinstance(err, SchedulerCrashed):
+            # a crashed decode loop is NOT routine draining: name it,
+            # so a crash storm never hides in the graceful-drain shed
+            # bucket
+            status, reason = 503, "crashed"
+            retry_after = self._retry_after(cls)
+            payload = {"error": str(err), "model": err.server or model,
+                       "class": cls}
+        elif isinstance(err, ServerClosed):
             status, reason = 503, "draining"
+            retry_after = self._retry_after(cls)
             payload = {"error": str(err), "model": err.server or model,
                        "class": cls}
         elif isinstance(err, DeadlineExceeded):
             status, reason = 504, "deadline"
+            retry_after = self._retry_after(cls)
             payload = {"error": str(err), "model": model, "class": cls}
         elif isinstance(err, RequestRejected):
             status, reason = 503, "shed"
+            retry_after = self._retry_after(cls)
             payload = {"error": str(err), "model": model, "class": cls}
         elif isinstance(err, MXNetError) and "unknown model" in str(err):
             status, reason = 404, "unknown_model"
@@ -799,6 +995,8 @@ class Gateway:
             status, reason = 500, "error"
             payload = {"error": "%s: %s" % (type(err).__name__, err),
                        "model": model}
+        if self._breaker_strike(err):
+            self.registry.record_failure(model, err)
         self._observe("shed" if status in (503, 504) else "error",
                       model, cls, route, status, t0, reason=reason)
-        handler._send_json(status, payload)
+        handler._send_json(status, payload, retry_after=retry_after)

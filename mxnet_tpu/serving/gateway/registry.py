@@ -34,10 +34,12 @@ import time
 from ...base import MXNetError, getenv
 from ...observability import registry as _obs
 from ...observability import telemetry as _telemetry
+from .. import health as _health
 from ..batcher import ServerClosed
+from ..health import BreakerOpen
 from ..server import ModelServer
 
-__all__ = ["ModelRegistry"]
+__all__ = ["ModelRegistry", "BreakerOpen"]
 
 RELOADS = _obs.counter(
     "serving.gateway.reload",
@@ -56,7 +58,8 @@ _RESIDENT_BYTES = _obs.gauge(
 class _Entry:
     __slots__ = ("name", "builder", "eager", "warmup", "server_kwargs",
                  "server", "bytes", "state", "last_used", "loads",
-                 "requests")
+                 "requests", "breaker", "fails", "opened_at",
+                 "breaker_opens", "canary_live", "canary_owner")
 
     def __init__(self, name, builder, eager, warmup, server_kwargs):
         self.name = name
@@ -70,6 +73,18 @@ class _Entry:
         self.last_used = 0
         self.loads = 0
         self.requests = 0
+        # per-model circuit breaker (docs/fault_tolerance.md "Serving
+        # resilience"): closed -> open (MXTPU_BREAKER_FAILS
+        # consecutive load/infer failures; instant refusal, no builder
+        # hammering) -> half_open (one canary request after the
+        # cooldown) -> closed on its success
+        self.breaker = "closed"
+        self.fails = 0
+        self.opened_at = 0.0
+        self.breaker_opens = 0
+        self.canary_live = False     # half_open: ONE canary at a time
+        self.canary_owner = None     # the granted thread — its own
+        #                              eviction-race retry re-enters
 
 
 class ModelRegistry:
@@ -197,6 +212,7 @@ class ModelRegistry:
                     server=name)
             if _count_request:
                 e.requests += 1
+            self._breaker_gate_locked(e)
             while e.state == "loading":
                 self._cond.wait(0.05)
             if self._closed:
@@ -216,10 +232,20 @@ class ModelRegistry:
                                     **e.server_kwargs)
             built.start()
             nbytes = built.device_bytes()
-        except BaseException:
+        except BaseException as err:
             with self._cond:
                 e.state = "cold"
                 self._cond.notify_all()
+            # a failed load is a breaker strike: a builder that keeps
+            # failing stops being re-hammered by every request. Tag
+            # the error so the gateway's generic-500 strike doesn't
+            # count the SAME failure twice (docs say consecutive
+            # failures, not consecutive accounting sites)
+            self.record_failure(name, err)
+            try:
+                err._mxtpu_breaker_counted = True
+            except AttributeError:
+                pass     # exceptions with __slots__: stay single-count
             raise
         load_s = time.perf_counter() - t0
         with self._cond:
@@ -262,8 +288,112 @@ class ModelRegistry:
                 "event": "reload", "step_time": load_s,
                 "model": name, "bytes": int(nbytes),
             })
+        # a successful (re)load is breaker evidence too: a half-open
+        # canary whose LOAD was the failing part closes here (infer
+        # outcomes additionally report via record_success/failure)
+        self.record_success(name)
         self._evict_to_fit(exclude=name)
         return built
+
+    # ------------------------------------------------------------------
+    # per-model circuit breaker (docs/fault_tolerance.md)
+    # ------------------------------------------------------------------
+    def _breaker_gate_locked(self, e):
+        """Refuse instantly while `e`'s breaker is open (no builder
+        hammering, no compute); past the cooldown flip to half_open
+        and admit exactly ONE canary request. Caller holds the lock."""
+        if e.breaker == "closed":
+            return
+        cooldown = _health.breaker_cooldown()
+        if e.breaker == "open":
+            remaining = e.opened_at + cooldown - time.monotonic()
+            if remaining > 0:
+                raise BreakerOpen(
+                    "model %r circuit breaker is open after %d "
+                    "consecutive failures; retry in %.3gs"
+                    % (e.name, e.fails, remaining),
+                    model=e.name, retry_after_s=remaining)
+            e.breaker = "half_open"
+            e.canary_live = False
+            e.canary_owner = None
+            _health.set_breaker_state(e.name, "half_open",
+                                      reason="cooldown")
+        # a canary that never reported (an embedded caller using get()
+        # alone) must not jam the breaker: its grant expires after one
+        # cooldown and the next request becomes the canary. The
+        # grant-HOLDING thread re-enters freely — the gateway's
+        # eviction-race retry calls get() again for the same request,
+        # and refusing our own canary would leave the breaker
+        # un-probed for a full extra cooldown
+        if e.canary_live and \
+                e.canary_owner != threading.get_ident() and \
+                time.monotonic() - e.opened_at <= cooldown:
+            raise BreakerOpen(
+                "model %r breaker is half-open with a canary request "
+                "in flight; retry shortly" % e.name, model=e.name,
+                retry_after_s=min(1.0, cooldown))
+        e.canary_live = True
+        e.canary_owner = threading.get_ident()
+        e.opened_at = time.monotonic()
+
+    def record_success(self, name):
+        """A request for `name` completed: reset the strike count and
+        close a half-open breaker (the canary succeeded). An OPEN
+        breaker is deliberately NOT closed here — a straggler success
+        from a request admitted before the failures must not skip the
+        open → half_open → canary discipline and re-hammer the model
+        mid-cooldown."""
+        # racy lock-free fast path for the overwhelmingly common case
+        # (breaker clean): the gateway calls this on EVERY served
+        # request, and serializing all handler threads on the registry
+        # lock just to re-write fails=0 would be a hot-path tax. Both
+        # fields only ever need correcting after an actual failure, so
+        # a stale read merely defers the reset to the locked path.
+        e = self._entries.get(name)
+        if e is None or (e.breaker == "closed" and e.fails == 0):
+            return
+        with self._cond:
+            e = self._entries.get(name)
+            if e is None or e.breaker == "open":
+                return
+            closed = e.breaker == "half_open"
+            e.fails = 0
+            e.canary_live = False
+            e.canary_owner = None
+            e.breaker = "closed"
+        if closed:
+            _health.set_breaker_state(name, "closed",
+                                      reason="canary_success")
+
+    def record_failure(self, name, err=None):
+        """A load or infer for `name` failed server-side: one breaker
+        strike. MXTPU_BREAKER_FAILS consecutive strikes (or any
+        half-open canary failure) open the breaker."""
+        with self._cond:
+            e = self._entries.get(name)
+            if e is None:
+                return
+            e.fails += 1
+            e.canary_live = False
+            e.canary_owner = None
+            opened = (e.breaker == "half_open"
+                      or (e.breaker == "closed"
+                          and e.fails >= _health.breaker_fails()))
+            if opened:
+                e.breaker = "open"
+                e.opened_at = time.monotonic()
+                e.breaker_opens += 1
+        if opened:
+            _health.BREAKER_OPENS.inc(model=name)
+            _health.set_breaker_state(
+                name, "open",
+                reason=type(err).__name__ if err is not None
+                else "failure")
+
+    def breaker_state(self, name):
+        with self._cond:
+            e = self._entries.get(name)
+            return None if e is None else e.breaker
 
     # ------------------------------------------------------------------
     # budget / eviction
@@ -384,6 +514,9 @@ class ModelRegistry:
                     "requests": e.requests,
                     "last_used": e.last_used,
                     "eager": e.eager,
+                    "breaker": e.breaker,
+                    "breaker_fails": e.fails,
+                    "breaker_opens": e.breaker_opens,
                 } for e in self._entries.values()}
             return {
                 "budget_bytes": self.budget_bytes,

@@ -51,10 +51,13 @@ from ..observability import telemetry as _telemetry
 from ..observability import trace as _trace
 from ..observability.span import capture_context, restored
 from ..resilience import DeadlineExceeded, chaos_point
+from . import health as _health
 from .batcher import RequestRejected, ServerClosed
 from .decode import DecodeEngine
+from .health import DeviceUnreachable, SchedulerCrashed
 
-__all__ = ["ContinuousBatchScheduler", "DecodeRequest"]
+__all__ = ["ContinuousBatchScheduler", "DecodeRequest",
+           "SchedulerCrashed"]
 
 _TTFT = _obs.histogram(
     "serving.decode.ttft",
@@ -84,8 +87,8 @@ class DecodeRequest:
 
     __slots__ = ("tokens", "max_new_tokens", "deadline", "eos_token",
                  "source", "trace", "enqueued_at", "resolved_at",
-                 "token_times", "generated", "slot", "_event",
-                 "_outputs", "_error")
+                 "token_times", "generated", "slot", "cancelled",
+                 "_event", "_outputs", "_error")
 
     def __init__(self, tokens, max_new_tokens, deadline=None,
                  eos_token=None, source="decode"):
@@ -103,6 +106,7 @@ class DecodeRequest:
         self.token_times = []
         self.generated = []
         self.slot = None            # cache slot while decoding
+        self.cancelled = False
         self._event = threading.Event()
         self._outputs = None
         self._error = None
@@ -138,6 +142,14 @@ class DecodeRequest:
         return ctx if ctx is not None and ctx.sampled else None
 
     # -- client side ---------------------------------------------------
+    def cancel(self):
+        """The client abandoned the request (e.g. a broken streaming
+        connection): a queued request is rejected at the next pop, a
+        decoding one is EVICTED at the next step boundary — its KV
+        slot frees immediately instead of leaking until
+        max_new_tokens. Safe from any thread; a no-op once resolved."""
+        self.cancelled = True
+
     def done(self):
         return self._event.is_set()
 
@@ -168,12 +180,15 @@ class ContinuousBatchScheduler:
     """
 
     def __init__(self, engine, max_new_tokens=None, queue_depth=None,
-                 shed_policy=None, name=None):
+                 shed_policy=None, name=None, replica=0):
         if not isinstance(engine, DecodeEngine):
             raise MXNetError("ContinuousBatchScheduler wants a "
                              "DecodeEngine")
         self.engine = engine
         self.name = name or engine.name
+        #: which serving replica this scheduler is (ModelServer's
+        #: index) — the chaos-site address and metric label
+        self.replica = int(replica)
         self.max_new_tokens = int(
             max_new_tokens if max_new_tokens is not None
             else getenv("MXTPU_DECODE_MAX_NEW", 32))
@@ -197,6 +212,17 @@ class ContinuousBatchScheduler:
         self.evicted = 0
         self.served = 0
         self.tokens_out = 0
+        # replica health (docs/fault_tolerance.md "Serving
+        # resilience"): a wedged dispatch trips the watchdog; past
+        # MXTPU_SERVE_TRIP_LIMIT consecutive trips the scheduler
+        # quarantines ITSELF (real requests stop prefilling; a canary
+        # probe re-admits it); a crashed loop is terminal ("dead")
+        self.state = "healthy"
+        self.trips = 0
+        self.crashed = None
+        self._consec_trips = 0
+        self._last_canary = 0.0
+        self._watchdog = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="decode-sched-%s" % self.name)
@@ -261,6 +287,12 @@ class ContinuousBatchScheduler:
         if req.max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
         with self._cond:
+            if self.crashed is not None:
+                raise SchedulerCrashed(
+                    "decode scheduler %r crashed (%s: %s); request "
+                    "refused" % (self.name,
+                                 type(self.crashed).__name__,
+                                 self.crashed), server=self.name)
             if self._closed:
                 raise ServerClosed(
                     "scheduler %r is draining; request refused"
@@ -296,6 +328,14 @@ class ContinuousBatchScheduler:
         with self._cond:
             return len(self._queue) + len(self._inflight)
 
+    def alive(self):
+        """False once the loop thread died (crash or drain complete):
+        ModelServer stops routing submits here — a dead scheduler
+        must never silently accumulate a queue nobody drains."""
+        if not self._started:
+            return True              # startable: ModelServer starts it
+        return self._thread.is_alive() and not self._stopped.is_set()
+
     def stats(self):
         with self._cond:
             queued = len(self._queue)
@@ -316,59 +356,238 @@ class ContinuousBatchScheduler:
             "steps": self.engine.steps,
             "compiled_programs": self.engine.compiled_programs,
             "draining": self._closed,
+            # replica health surface (/debugz drill-down)
+            "state": self.state,
+            "alive": self.alive(),
+            "trips": self.trips,
+            "crashed": (None if self.crashed is None
+                        else repr(self.crashed)),
         }
 
     # ------------------------------------------------------------------
     # the scheduling loop (one thread; the engine is single-consumer)
     # ------------------------------------------------------------------
     def _loop(self):
+        crash = None
         try:
             while True:
                 with self._cond:
                     while not self._queue and not self._inflight \
-                            and not self._closed:
+                            and not self._closed \
+                            and self.state != "quarantined":
                         self._cond.wait(0.05)
                     if self._closed and not self._queue \
                             and not self._inflight:
                         return
+                if self.state == "quarantined":
+                    # real requests stop dispatching on a quarantined
+                    # replica; doomed queued ones still shed on time,
+                    # and a background canary probe re-admits it once
+                    # the device answers again
+                    if self._closed:
+                        # draining while the device is still wedged:
+                        # queued requests (deadline-less ones
+                        # included) can never be served here — reject
+                        # typed so drain() terminates instead of
+                        # waiting out a wedge that may never clear
+                        with self._cond:
+                            leftovers = list(self._queue)
+                            self._queue.clear()
+                            _QUEUE_DEPTH.set(0)
+                        for req in leftovers:
+                            self.shed += 1
+                            _SHED.inc(reason="quarantined")
+                            req.reject(ServerClosed(
+                                "scheduler %r is draining while its "
+                                "replica is quarantined (device "
+                                "wedged); request cannot be served"
+                                % self.name, server=self.name))
+                    self._sweep_queue()
+                    self._maybe_canary()
+                    if self.state == "quarantined":
+                        # idle at the canary cadence, not a busy poll —
+                        # unconditionally: close() notifies the cond so
+                        # drain stays prompt, and skipping the wait
+                        # when closed would spin this thread flat-out
+                        # while live queued requests outwait the wedge
+                        with self._cond:
+                            self._cond.wait(min(
+                                _health.canary_interval(), 0.25))
+                        continue
                 self._admit()
                 self._evict_expired()
                 if self._inflight:
                     self._step_once()
+        except BaseException as err:  # noqa: BLE001 — typed + surfaced
+            # a non-request-scoped crash: without this, _closed stays
+            # False and later submits enqueue into a loop nobody runs
+            # — their result() hangs forever (the pre-ISSUE-14 bug)
+            crash = err
+            _health.LOOP_CRASHES.inc(scheduler=self.name)
+            _health.marker("loop_crash", scheduler=self.name,
+                           error=type(err).__name__)
+            _health.emit_event("loop_crash", scheduler=self.name,
+                               error=repr(err))
         finally:
-            # belt and braces: a loop crash must not strand waiters —
-            # and the rejections must land BEFORE _stopped releases
-            # drain(), or a drain()er could observe "done" while a
-            # handle still has no outcome
+            # a crash must not strand waiters: close FIRST (so a
+            # racing submit is refused, not silently queued), then
+            # reject everything left — and the rejections must land
+            # BEFORE _stopped releases drain(), or a drain()er could
+            # observe "done" while a handle still has no outcome
             with self._cond:
+                self._closed = True
                 leftovers = list(self._queue) + list(
                     self._inflight.values())
                 self._queue.clear()
                 self._inflight.clear()
             for req in leftovers:
                 if not req.done():
-                    req.reject(ServerClosed(
-                        "decode scheduler %r stopped before the "
-                        "request finished" % self.name,
-                        server=self.name))
+                    if crash is not None:
+                        req.reject(SchedulerCrashed(
+                            "decode scheduler %r crashed (%s: %s) "
+                            "before the request finished"
+                            % (self.name, type(crash).__name__, crash),
+                            server=self.name))
+                    else:
+                        req.reject(ServerClosed(
+                            "decode scheduler %r stopped before the "
+                            "request finished" % self.name,
+                            server=self.name))
+            if crash is not None:
+                self.crashed = crash
+                self.state = "dead"
+                _health.set_replica_state(self.name, self.replica,
+                                          "dead", reason="loop_crash")
             self._stopped.set()
 
+    def _reject_doomed(self, req):
+        """Shed a queued request nobody can use anymore (cancelled
+        client, expired deadline) with the standard accounting; True
+        when it was doomed. One policy for BOTH the admission pop and
+        the quarantine sweep — the two paths must never diverge."""
+        if req.cancelled:
+            self.shed += 1
+            _SHED.inc(reason="cancelled")
+            req.reject(RequestRejected(
+                "request cancelled by the client while queued"))
+            return True
+        if req.deadline is not None and req.deadline.expired():
+            self.shed += 1
+            _SHED.inc(reason="deadline")
+            req.reject(DeadlineExceeded(
+                "request deadline expired after %.6gs in queue"
+                % (time.perf_counter() - req.enqueued_at)))
+            return True
+        return False
+
     def _pop_live(self):
-        """Next queued request whose deadline has not expired; doomed
-        ones are rejected on the spot, never prefilled."""
+        """Next queued request whose deadline has not expired (and
+        whose client still wants it); doomed ones are rejected on the
+        spot, never prefilled."""
         with self._cond:
             while self._queue:
                 req = self._queue.popleft()
                 _QUEUE_DEPTH.set(len(self._queue))
-                if req.deadline is not None and req.deadline.expired():
-                    self.shed += 1
-                    _SHED.inc(reason="deadline")
-                    req.reject(DeadlineExceeded(
-                        "request deadline expired after %.6gs in queue"
-                        % (time.perf_counter() - req.enqueued_at)))
-                    continue
-                return req
+                if not self._reject_doomed(req):
+                    return req
         return None
+
+    def _sweep_queue(self):
+        """While quarantined nothing is admitted, but doomed queued
+        requests (expired deadline, cancelled client) must still shed
+        on time instead of aging silently."""
+        with self._cond:
+            live = deque(req for req in self._queue
+                         if not self._reject_doomed(req))
+            self._queue = live
+            _QUEUE_DEPTH.set(len(self._queue))
+
+    # -- watchdog-bounded dispatch + replica health --------------------
+    def _wd(self):
+        if self._watchdog is None:
+            self._watchdog = _health.HealthWatchdog()
+        return self._watchdog
+
+    def _sites(self):
+        return ("engine.dispatch", _health.replica_site(self.replica))
+
+    def _on_trip(self):
+        """One dispatch-watchdog trip on this replica: count it, and
+        past MXTPU_SERVE_TRIP_LIMIT consecutive trips quarantine the
+        scheduler (canary-probed until the device answers again)."""
+        self.trips += 1
+        self._consec_trips += 1
+        _health.record_trip(self.name, self.replica)
+        if self._consec_trips >= _health.trip_limit() \
+                and self.state == "healthy":
+            self.state = "quarantined"
+            _health.record_quarantine(self.name, self.replica)
+
+    def _note_dispatch_ok(self):
+        self._consec_trips = 0
+        if self.state == "quarantined":
+            self.state = "healthy"
+            _health.record_readmit(self.name, self.replica)
+
+    def _rebuild_engine(self):
+        """After a dispatch trip the wedged call still holds the
+        engine's donated cache buffers on a daemon thread and will
+        mutate engine state whenever it finally returns — the instance
+        is unsalvageable. A sibling engine (same block, fresh cache and
+        programs) replaces it; the zombie's late writes land on the
+        abandoned object."""
+        old = self.engine
+        self.engine = old.replicate(old.device, name=old.name)
+
+    def _fault_reset(self, err, wedged=False):
+        """Past a failed prefill/step the in-flight cache state is
+        unknown: fail the sequences, restore a clean engine, keep
+        serving the queue. `wedged` (a watchdog trip) swaps in a fresh
+        engine instance; an ordinary compute error just resets."""
+        for slot, req in list(self._inflight.items()):
+            req.reject(err)
+        self._inflight.clear()
+        if wedged:
+            self._rebuild_engine()
+        else:
+            for slot in self.engine.active_slots:
+                self.engine.retire(slot)
+            self.engine.reset()
+
+    def _maybe_canary(self):
+        """One warm-bucket probe dispatch per MXTPU_SERVE_CANARY_S
+        while quarantined: success re-admits the replica, a trip (or
+        any error) keeps it out with a fresh engine."""
+        now = time.monotonic()
+        if now - self._last_canary < _health.canary_interval():
+            return
+        self._last_canary = now
+        engine = self.engine
+        try:
+            slot = engine.free_slots[0]
+            _health.guard(
+                self._wd(),
+                lambda: engine.prefill(np.zeros(1, np.int32), slot),
+                what="decode canary (%s)" % self.name,
+                sites=self._sites())
+            engine.retire(slot)
+        except DeviceUnreachable:
+            # a wedged probe: the zombie dispatch still holds the
+            # donated cache — only THIS case needs a fresh engine
+            self._on_trip()
+            self._rebuild_engine()
+            return
+        except Exception:  # noqa: BLE001 — the probe proved nothing
+            # an ordinary error (chaos kind=raise, transient compute
+            # failure): the engine state is intact — rebuilding here
+            # would re-pay every XLA compile per canary interval, a
+            # recompile storm on an already-degraded box
+            try:
+                engine.retire(slot)
+            except Exception:  # noqa: BLE001 — slot may not be active
+                pass
+            return
+        self._note_dispatch_ok()
 
     def _admit(self):
         """Fill free cache slots from the queue (oldest first). Each
@@ -388,10 +607,29 @@ class ContinuousBatchScheduler:
                 with restored(req.trace), \
                         _trace.trace_span("decode.prefill", slot=slot,
                                           tokens=int(req.tokens.size)):
-                    first = engine.prefill(req.tokens, slot)
+                    first = _health.guard(
+                        self._wd(),
+                        lambda: engine.prefill(req.tokens, slot),
+                        what="decode prefill (%s)" % self.name,
+                        sites=self._sites())
+            except DeviceUnreachable as err:
+                # the wedged prefill may still consume the donated
+                # cache on its daemon thread: in-flight state is
+                # unknown — same blast radius as a wedged step. The
+                # tripped request itself was NOT computed: requeue it
+                # at the head (it rides the recovered replica after
+                # the canary re-admits, or sheds on its deadline) —
+                # only sequences already mid-decode fail typed
+                with self._cond:
+                    self._queue.appendleft(req)
+                    _QUEUE_DEPTH.set(len(self._queue))
+                self._on_trip()
+                self._fault_reset(err, wedged=True)
+                return
             except Exception as err:  # noqa: BLE001 — delivered
                 req.reject(err)
                 continue
+            self._note_dispatch_ok()
             req.slot = slot
             req.push_token(first)
             self._inflight[slot] = req
@@ -405,10 +643,20 @@ class ContinuousBatchScheduler:
 
     def _evict_expired(self):
         """The Deadline contract at token granularity: a sequence whose
-        budget ran out is evicted BETWEEN steps — its slot frees for
-        the queue, and no further tokens are computed for it."""
+        budget ran out — or whose client disconnected (`cancel()`) —
+        is evicted BETWEEN steps: its slot frees for the queue, and no
+        further tokens are computed for it."""
         for slot, req in list(self._inflight.items()):
-            if req.deadline is not None and req.deadline.expired():
+            if req.cancelled:
+                self.engine.retire(slot)
+                del self._inflight[slot]
+                self.evicted += 1
+                _EVICTIONS.inc(reason="cancelled")
+                req.reject(RequestRejected(
+                    "request cancelled by the client after %d "
+                    "generated tokens; sequence evicted and its slot "
+                    "freed" % len(req.generated)))
+            elif req.deadline is not None and req.deadline.expired():
                 self.engine.retire(slot)
                 del self._inflight[slot]
                 self.evicted += 1
@@ -455,16 +703,23 @@ class ContinuousBatchScheduler:
         _FILL.observe(fill, engine=engine.name)
         try:
             chaos_point("serving.decode")
-            next_tokens = engine.step()
+            next_tokens = _health.guard(
+                self._wd(), engine.step,
+                what="decode step (%s)" % self.name,
+                sites=self._sites())
+        except DeviceUnreachable as err:
+            # a wedged step: typed, counted, quarantine-eligible — and
+            # the donated cache is unrecoverable (the zombie dispatch
+            # still holds it), so a fresh engine replaces it
+            self._on_trip()
+            self._fault_reset(err, wedged=True)
+            return
         except Exception as err:  # noqa: BLE001 — delivered per request
             # past a failed step the in-flight cache state is unknown:
             # fail the sequences, clear the slots, keep serving
-            for slot, req in list(self._inflight.items()):
-                engine.retire(slot)
-                req.reject(err)
-            self._inflight.clear()
-            engine.reset()
+            self._fault_reset(err)
             return
+        self._note_dispatch_ok()
         produced = 0
         for slot, req in list(self._inflight.items()):
             req.push_token(next_tokens[slot])

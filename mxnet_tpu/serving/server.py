@@ -37,9 +37,11 @@ from ..observability import telemetry as _telemetry
 from ..observability import trace as _trace
 from ..resilience import chaos_point
 from ..resilience import lease as _lease
+from . import health as _health
 from .batcher import DynamicBatcher, ServerClosed
 from .decode import DecodeEngine
 from .engine import InferenceEngine
+from .health import DeviceUnreachable, NoHealthyReplica
 from .scheduler import ContinuousBatchScheduler
 
 __all__ = ["ModelServer"]
@@ -60,7 +62,15 @@ def _local_devices():
 
 
 class _Worker:
-    """One serving replica: a thread draining its private batch queue."""
+    """One serving replica: a thread draining its private batch queue.
+
+    Health state machine (docs/fault_tolerance.md "Serving
+    resilience"): ``healthy`` takes traffic; ``quarantined`` (after
+    MXTPU_SERVE_TRIP_LIMIT consecutive dispatch-watchdog trips) is
+    skipped by the dispatcher until the server's canary probe
+    re-admits it; ``dead`` (the thread exited on a non-request-scoped
+    error) is terminal — its queued batches re-dispatch to survivors.
+    """
 
     def __init__(self, server, index, device):
         self.server = server
@@ -70,11 +80,26 @@ class _Worker:
         self.inflight_rows = 0      # guarded by server._lock
         self.served_requests = 0
         self.served_batches = 0
+        self.state = "healthy"      # guarded by server._lock
+        self.trips = 0
+        self._consec_trips = 0      # guarded by server._lock
+        self.death = None
+        self.watchdog = _health.HealthWatchdog()
+        self._current = None        # batch in hand, for death cleanup
         self.thread = threading.Thread(
             target=self._loop, daemon=True,
             name="serving-worker-%d" % index)
 
     def _loop(self):
+        try:
+            self._run()
+        except BaseException as err:  # noqa: BLE001 — typed + surfaced
+            # a crash outside the request scope (ISSUE-14 satellite):
+            # without this the dispatcher keeps feeding a dead replica
+            # and its queue strands silently
+            self.server._on_worker_death(self, err)
+
+    def _run(self):
         srv = self.server
         while True:
             with srv._lock:
@@ -86,8 +111,13 @@ class _Worker:
                 # a backlog slot opened: the dispatcher may pop the
                 # next coalesced batch from the bounded batcher queue
                 srv._slot_free.notify_all()
+            self._current = batch
             try:
                 srv._run_batch(self, batch)
+                # cleared only on the clean path: if _run_batch raised
+                # (this thread is dying), _on_worker_death re-dispatches
+                # the in-hand batch via _current
+                self._current = None
             finally:
                 rows = sum(r.n for r in batch)
                 with srv._lock:
@@ -142,7 +172,7 @@ class ModelServer:
                 ContinuousBatchScheduler(
                     e, max_new_tokens=max_new_tokens,
                     queue_depth=queue_depth, shed_policy=shed_policy,
-                    name="%s/%d" % (engine.name, i))
+                    name="%s/%d" % (engine.name, i), replica=i)
                 for i, e in enumerate(engines)]
             self._started = False
             self._draining = False
@@ -401,7 +431,18 @@ class ModelServer:
                 "server %r is draining; request refused"
                 % self.engine.name, server=self.engine.name)
         if self.kind == "decode":
-            sched = min(self._schedulers, key=lambda s: s.load())
+            # route around dead replicas (a crashed scheduler loop
+            # must not silently accumulate a queue nobody drains) and
+            # prefer healthy ones over quarantined; requests fail
+            # typed ONLY when no replica survives at all
+            live = [s for s in self._schedulers if s.alive()]
+            if not live:
+                raise NoHealthyReplica(
+                    "every decode replica of server %r is dead "
+                    "(crashed or stopped); request refused"
+                    % self.engine.name, server=self.engine.name)
+            healthy = [s for s in live if s.state == "healthy"] or live
+            sched = min(healthy, key=lambda s: s.load())
             return sched.submit(inputs, deadline=deadline,
                                 **decode_kwargs)
         if decode_kwargs:
@@ -427,17 +468,59 @@ class ModelServer:
     # ------------------------------------------------------------------
     # dispatch + compute
     # ------------------------------------------------------------------
+    def _worker_eligible_locked(self, w):
+        """Routable replica: healthy state, thread still running.
+        Caller holds the lock."""
+        return w.state == "healthy" and w.thread.is_alive()
+
+    def _scan_dead(self):
+        """Belt-and-braces dead-thread sweep (the worker's own wrapper
+        normally reports its death): a healthy-state worker whose
+        thread is gone stops receiving traffic NOW, not at the next
+        wedge."""
+        if self._stopping:
+            return      # drain: threads exit on purpose
+        with self._lock:
+            dead = [w for w in self._workers
+                    if w.state != "dead" and w.thread.ident is not None
+                    and not w.thread.is_alive()]
+        for w in dead:
+            self._on_worker_death(w, w.death)
+
     def _dispatch_loop(self):
         while True:
             if self._drain_requested and not self.batcher.closed:
                 self.batcher.close()     # finish queued, reject new
+            self._scan_dead()
             # backpressure: don't pop from the BOUNDED batcher queue
-            # until some worker has a free backlog slot (at most one
-            # queued batch per worker) — draining into unbounded worker
-            # lists would keep the batcher near-empty and defeat the
-            # queue_depth/shedding contract under sustained overload
+            # until some ELIGIBLE worker has a free backlog slot (at
+            # most one queued batch per worker) — draining into
+            # unbounded worker lists would keep the batcher near-empty
+            # and defeat the queue_depth/shedding contract under
+            # sustained overload. With NO eligible worker, fall
+            # through: the batch is popped and failed typed below
+            # instead of aging silently in the queue
             with self._lock:
-                while all(w._queue for w in self._workers):
+                while True:
+                    eligible = [w for w in self._workers
+                                if self._worker_eligible_locked(w)]
+                    if eligible:
+                        if any(not w._queue for w in eligible):
+                            break
+                    else:
+                        # no routable worker: if any replica is
+                        # quarantined its canary may re-admit it —
+                        # hold the queue (requests shed on their own
+                        # deadlines) instead of insta-failing a
+                        # transient wedge; with only corpses left, or
+                        # while draining, fall through and fail typed
+                        recovering = any(
+                            w.state != "dead"
+                            and w.thread.is_alive()
+                            for w in self._workers)
+                        if not recovering or self.batcher.closed \
+                                or self._drain_requested:
+                            break
                     self._slot_free.wait(0.1)
             batch = self.batcher.next_batch(timeout=0.1)
             if batch is None:
@@ -446,12 +529,32 @@ class ModelServer:
                 continue
             rows = sum(r.n for r in batch)
             with self._lock:
-                free = [w for w in self._workers if not w._queue]
-                worker = min(free or self._workers,
-                             key=lambda w: w.inflight_rows)
-                worker.inflight_rows += rows
-                worker._queue.append(batch)
-                self._work_ready.notify_all()
+                eligible = [w for w in self._workers
+                            if self._worker_eligible_locked(w)]
+                worker = None
+                if eligible:
+                    free = [w for w in eligible if not w._queue]
+                    worker = min(free or eligible,
+                                 key=lambda w: w.inflight_rows)
+                    worker.inflight_rows += rows
+                    worker._queue.append(batch)
+                    self._work_ready.notify_all()
+            if worker is None:
+                # graceful degradation's floor: requests fail typed
+                # ONLY when no replica survives (recovering=True when
+                # a canary may still bring one back — not a breaker
+                # strike)
+                recovering = any(w.state != "dead"
+                                 and w.thread.is_alive()
+                                 for w in self._workers)
+                err = NoHealthyReplica(
+                    "no healthy replica left for server %r (every "
+                    "worker is dead or quarantined); request refused"
+                    % self.engine.name, server=self.engine.name,
+                    recovering=recovering)
+                for req in batch:
+                    req.reject(err)
+                _REQS_FAILED.inc(len(batch))
 
     def _run_batch(self, worker, batch):
         t0 = time.perf_counter()
@@ -477,15 +580,34 @@ class ModelServer:
                            [r.inputs[name] for r in batch], axis=0))
                 for name in self.engine.data_names}
             t_disp = time.perf_counter()
-            with _trace.attached(trace_ctx):
+
+            def dispatch():
                 outs = self.engine.infer(stacked, n=rows,
                                          device=worker.device)
-                # responses are HOST arrays: one device sync per output
-                # per batch, then zero-copy numpy views per request — a
-                # jax slice op per request would hand back the very
-                # dispatch overhead the coalescing just amortized away
-                host = [o.asnumpy() for o in outs]
+                # responses are HOST arrays: one device sync per
+                # output per batch, then zero-copy numpy views per
+                # request — a jax slice op per request would hand back
+                # the very dispatch overhead the coalescing just
+                # amortized away
+                return [o.asnumpy() for o in outs]
+
+            with _trace.attached(trace_ctx):
+                # watchdog-bounded (MXTPU_SERVE_DISPATCH_TIMEOUT_S;
+                # off by default = the plain direct call): a wedged
+                # XLA dispatch trips typed instead of hanging every
+                # request on this replica forever
+                host = _health.guard(
+                    worker.watchdog, dispatch,
+                    what="engine %r dispatch (replica %d)"
+                         % (self.engine.name, worker.index),
+                    sites=("engine.dispatch",
+                           _health.replica_site(worker.index)))
             t_done = time.perf_counter()
+        except DeviceUnreachable as err:
+            # the wedge signal: trip accounting, maybe quarantine, and
+            # the batch rides a surviving replica instead of failing
+            self._on_worker_trip(worker, batch, err)
+            return
         except Exception as err:   # noqa: BLE001 — delivered per request
             for req in batch:
                 req.reject(err)
@@ -511,6 +633,8 @@ class ModelServer:
                     parent_id=bid)
         worker.served_requests += len(batch)
         worker.served_batches += 1
+        with self._lock:
+            worker._consec_trips = 0    # a good dispatch clears strikes
         _REQS_SERVED.inc(len(batch))
         dt = time.perf_counter() - t0
         _BATCH_SECONDS.observe(dt)
@@ -527,6 +651,164 @@ class ModelServer:
                 "shed_total": self.batcher.shed,
                 "worker": worker.index,
             })
+
+    # ------------------------------------------------------------------
+    # replica health (docs/fault_tolerance.md "Serving resilience")
+    # ------------------------------------------------------------------
+    def _on_worker_trip(self, worker, batch, err):
+        """One dispatch-watchdog trip on `worker`: count it, past
+        MXTPU_SERVE_TRIP_LIMIT consecutive trips quarantine the
+        replica (the canary probe re-admits it once the device answers
+        again), and re-dispatch the tripped batch to a surviving
+        replica — requests only fail when none survives."""
+        worker.trips += 1
+        _health.record_trip(self.engine.name, worker.index)
+        quarantine = False
+        with self._lock:
+            worker._consec_trips += 1
+            if worker._consec_trips >= _health.trip_limit() \
+                    and worker.state == "healthy":
+                worker.state = "quarantined"
+                quarantine = True
+        if quarantine:
+            _health.record_quarantine(self.engine.name, worker.index)
+            self._ensure_canary()
+        self._redispatch(worker, batch, err)
+
+    def _redispatch(self, source, batch, err):
+        """Hand a failed replica's batch to a surviving one (graceful
+        degradation). Re-dispatch attempts are capped per request so a
+        systemic fault can't cycle a batch forever; with no surviving
+        replica the requests fail typed (`NoHealthyReplica`) — the one
+        case where they fail at all."""
+        live = []
+        for req in batch:
+            req.attempts += 1
+            if req.attempts > max(2, len(self._workers)):
+                req.reject(err)
+                _REQS_FAILED.inc()
+            else:
+                live.append(req)
+        if not live:
+            return
+        with self._lock:
+            targets = [w for w in self._workers if w is not source
+                       and self._worker_eligible_locked(w)]
+            if targets:
+                rows = sum(r.n for r in live)
+                w = min(targets, key=lambda t: t.inflight_rows)
+                w.inflight_rows += rows
+                w._queue.append(live)
+                self._work_ready.notify_all()
+                return
+        with self._lock:
+            # ANY live replica (quarantined or merely mid-trip) can
+            # recover via canary/clean dispatch: only an all-corpses
+            # outage is breaker-strike evidence
+            recovering = any(w.state != "dead"
+                             and w.thread.is_alive()
+                             for w in self._workers)
+        fail = NoHealthyReplica(
+            "no healthy replica left for server %r: %s"
+            % (self.engine.name, err), server=self.engine.name,
+            recovering=recovering)
+        for req in live:
+            req.reject(fail)
+        _REQS_FAILED.inc(len(live))
+
+    def _on_worker_death(self, worker, err=None):
+        """A worker thread died outside the request scope: terminal.
+        Stop routing to it, zero its accounting (drain() must not wait
+        on a corpse), re-dispatch everything it still held, surface
+        the state everywhere."""
+        with self._lock:
+            if worker.state == "dead":
+                return
+            worker.state = "dead"
+            worker.death = err
+            stranded = list(worker._queue)
+            if worker._current is not None:
+                stranded.append([r for r in worker._current
+                                 if not r.done()])
+            worker._queue = []
+            worker._current = None
+            worker.inflight_rows = 0
+            self._idle.notify_all()
+            self._slot_free.notify_all()
+            self._work_ready.notify_all()
+        _health.WORKER_DEATHS.inc(server=self.engine.name,
+                                  replica=str(worker.index))
+        _health.marker("worker_death", server=self.engine.name,
+                       replica=worker.index,
+                       error=type(err).__name__ if err else "-")
+        _health.set_replica_state(self.engine.name, worker.index,
+                                  "dead", reason="worker_death")
+        base = err if err is not None else MXNetError(
+            "serving worker %d of %r died" % (worker.index,
+                                              self.engine.name))
+        for batch in stranded:
+            if batch:
+                self._redispatch(worker, batch, base)
+
+    def _ensure_canary(self):
+        """The background canary probe: one warm-bucket dispatch per
+        quarantined replica per MXTPU_SERVE_CANARY_S; success
+        re-admits the replica. Started lazily at the first
+        quarantine."""
+        with self._lock:
+            th = getattr(self, "_canary_thread", None)
+            if th is not None and th.is_alive():
+                return
+            self._canary_thread = threading.Thread(
+                target=self._canary_loop, daemon=True,
+                name="serving-canary-%s" % self.engine.name)
+            self._canary_thread.start()
+
+    def _canary_loop(self):
+        while not self._stopping and not self.draining:
+            time.sleep(_health.canary_interval())
+            with self._lock:
+                quarantined = [w for w in self._workers
+                               if w.state == "quarantined"]
+                if not quarantined:
+                    # nothing left to probe: exit instead of waking
+                    # every interval for the server's lifetime — the
+                    # next quarantine lazily restarts us. Deregister
+                    # under the SAME lock _ensure_canary checks, so a
+                    # concurrent quarantine either sees us alive (we
+                    # will see its worker: it was marked before the
+                    # _ensure_canary call) or starts a fresh thread
+                    if self._canary_thread is threading.current_thread():
+                        self._canary_thread = None
+                    return
+            for w in quarantined:
+                self._canary_probe(w)
+
+    def _canary_probe(self, worker):
+        try:
+            _health.guard(
+                worker.watchdog,
+                lambda: self.engine.infer(self.engine.zero_inputs(1),
+                                          n=1, device=worker.device),
+                what="canary probe (replica %d)" % worker.index,
+                sites=("engine.dispatch",
+                       _health.replica_site(worker.index)))
+        except DeviceUnreachable:
+            # still wedged: counted, stays out
+            worker.trips += 1
+            _health.record_trip(self.engine.name, worker.index,
+                                kind="canary_trip")
+            return
+        except Exception:  # noqa: BLE001 — the probe proved nothing
+            return
+        with self._lock:
+            if worker.state != "quarantined":
+                return
+            worker.state = "healthy"
+            worker._consec_trips = 0
+            self._work_ready.notify_all()
+            self._slot_free.notify_all()
+        _health.record_readmit(self.engine.name, worker.index)
 
     def device_bytes(self):
         """Measured device-buffer bytes across this server's engines
@@ -558,6 +840,9 @@ class ModelServer:
                 "evicted": sum(p["evicted"] for p in per),
                 "tokens": sum(p["tokens"] for p in per),
                 "queued": sum(p["queued"] for p in per),
+                "healthy_workers": sum(1 for p in per
+                                       if p["state"] == "healthy"
+                                       and p["alive"]),
                 "draining": self.draining,
                 # device-lease snapshot (docs/fault_tolerance.md):
                 # None on CPU backends, holder/heartbeat info when the
@@ -570,6 +855,11 @@ class ModelServer:
                 "inflight_rows": w.inflight_rows,
                 "served_requests": w.served_requests,
                 "served_batches": w.served_batches,
+                # replica health surface (/debugz drill-down):
+                # dispatch stops routing to !alive / !healthy workers
+                "state": w.state,
+                "alive": w.thread.is_alive(),
+                "trips": w.trips,
             } for w in self._workers]
         # this server's own labelset — two servers in one process must
         # not report each other's tails
@@ -589,6 +879,9 @@ class ModelServer:
             "shed": self.batcher.shed,
             "served": sum(w["served_requests"] for w in workers),
             "batches": sum(w["served_batches"] for w in workers),
+            "healthy_workers": sum(1 for w in workers
+                                   if w["state"] == "healthy"
+                                   and w["alive"]),
             "draining": self.draining,
             "request_latency_p50_s": lat.percentile(0.50, **labels),
             "request_latency_p95_s": lat.percentile(0.95, **labels),

@@ -24,6 +24,15 @@ injected death happens exactly once), and rank R SIGKILLs itself at
 training-step boundary K+1 — the end-to-end gang-restart proof
 (docs/fault_tolerance.md).
 
+Wedged-replica mode (`--wedge-replica R`): arm
+`serving.replica<R>.dispatch:kind=hang` (`--wedge-trips` hangs, then
+the fault clears) — the serving-resilience drill. The wrapped command
+must arm the dispatch watchdog (MXTPU_SERVE_DISPATCH_TIMEOUT_S > 0, or
+run `serve_bench --mode chaos` which arms it itself) and must emit an
+``MXTPU_SERVE`` marker (trip/quarantine evidence) or the run FAILS
+regardless of --expect — the same no-injection-detected guard as
+--nan-at-step.
+
 Numerics mode (`--nan-at-step K`, mirrors --kill-rank): arm
 `grad.post:kind=nan,after=K,n=1` — one NaN lands in a packed gradient
 flat after K clean draws, and the training numerics guard must skip
@@ -84,6 +93,18 @@ def main(argv=None):
                          "from relaunched generations — a global spec "
                          "would re-inject after every rollback and "
                          "loop the restart budget away")
+    ap.add_argument("--wedge-replica", type=int, default=None,
+                    help="arm serving.replica<R>.dispatch:kind=hang — "
+                         "the wedged-serving-replica drill "
+                         "(docs/fault_tolerance.md \"Serving "
+                         "resilience\"). The run must emit an "
+                         "MXTPU_SERVE marker or it FAILS: a missed "
+                         "injection cannot report a pass")
+    ap.add_argument("--wedge-trips", type=int, default=3,
+                    help="with --wedge-replica: hangs injected before "
+                         "the fault clears (default 3 = the default "
+                         "MXTPU_SERVE_TRIP_LIMIT, so the replica "
+                         "quarantines then canary-recovers)")
     ap.add_argument("--after-steps", type=int, default=0,
                     help="with --kill-rank: survive this many training "
                          "steps before the SIGKILL (default 0: die at "
@@ -106,12 +127,15 @@ def main(argv=None):
     if not cmd:
         ap.error("no command given (put it after --)")
     if args.chaos is None and args.kill_rank is None \
-            and args.nan_at_step is None:
-        ap.error("need --chaos, --kill-rank and/or --nan-at-step")
+            and args.nan_at_step is None and args.wedge_replica is None:
+        ap.error("need --chaos, --kill-rank, --nan-at-step and/or "
+                 "--wedge-replica")
     if args.kill_rank is not None and args.kill_rank < 0:
         ap.error("--kill-rank must be a non-negative rank id")
     if args.nan_at_step is not None and args.nan_at_step < 0:
         ap.error("--nan-at-step must be a non-negative step index")
+    if args.wedge_replica is not None and args.wedge_replica < 0:
+        ap.error("--wedge-replica must be a non-negative replica id")
 
     # validate the spec HERE: a typo'd spec silently injecting nothing
     # would report a meaningless pass
@@ -133,6 +157,13 @@ def main(argv=None):
             chaos_spec = ";".join(filter(None, [chaos_spec, nan_spec]))
     elif args.nan_rank is not None:
         ap.error("--nan-rank needs --nan-at-step")
+    if args.wedge_replica is not None:
+        # N hangs, then the fault clears: with N >= the trip limit the
+        # replica quarantines, the canary re-admits it, and the
+        # MXTPU_SERVE markers prove the whole sequence ran
+        wedge_spec = "serving.replica%d.dispatch:kind=hang,n=%d" % (
+            args.wedge_replica, max(1, args.wedge_trips))
+        chaos_spec = ";".join(filter(None, [chaos_spec, wedge_spec]))
     sites = []
     if args.nan_at_step is not None and args.nan_rank is not None:
         sites += sorted(parse_spec(nan_spec))
@@ -184,6 +215,25 @@ def main(argv=None):
                 "emitted no MXTPU_NUMERICS marker — the grad.post "
                 "injection was never detected (site unreached, or the "
                 "guard is off: MXTPU_NUMERICS=0)" % args.nan_at_step)
+    if args.wedge_replica is not None and outcome in ("COMPLETED",
+                                                      "CLEAN_ERROR"):
+        # no-injection-detected guard: the serving resilience plane
+        # prints capped MXTPU_SERVE markers when a dispatch trips /
+        # a replica changes state. A run that finished without one
+        # means the hang never fired (replica id out of range, no
+        # serving traffic, or the watchdog is off so nothing tripped
+        # in bounded time) — a meaningless pass that must fail loudly
+        detected = [ln for ln in (out or "").splitlines()
+                    if ln.startswith("MXTPU_SERVE ")]
+        summary["serve_markers"] = len(detected)
+        if not detected:
+            ok = summary["ok"] = False
+            summary["note"] = (
+                "--wedge-replica %d unproven: the command finished "
+                "but emitted no MXTPU_SERVE marker — the dispatch "
+                "hang was never detected (site unreached, or "
+                "MXTPU_SERVE_DISPATCH_TIMEOUT_S is 0 so no watchdog "
+                "could trip it)" % args.wedge_replica)
     if args.kill_rank is not None and outcome == "COMPLETED":
         # a kill that never fired (rank id outside the gang, site
         # unreached) completing "cleanly" is the meaningless pass the

@@ -32,6 +32,15 @@ is rejected):
                           (source="compile"; docs/compilation.md) — a
                           rollout/restart that re-pays full compile
                           must fail the gate, not ship
+    --min-success-rate    floor on gateway request success rate:
+                          served / (served + errors) over
+                          ``source="gateway"`` records — sheds
+                          (explicit backpressure: 503/504 with
+                          Retry-After) are EXCLUDED, server-side
+                          errors are counted, so an overloaded-but-
+                          honest gateway passes and a faulting one
+                          fails (docs/fault_tolerance.md "Serving
+                          resilience")
     --max-p99-ms-class CLASS=MS
                           per-priority-class gateway p99 latency budget
                           in milliseconds over ``source="gateway"``
@@ -97,6 +106,8 @@ def evaluate(summary, args):
     check("skipped_steps", "skipped_steps", args.max_skipped_steps, le)
     check("anomalies", "anomalies", args.max_anomalies, le)
     check("cold_start_s", "cold_start_max_s", args.max_cold_start_s, le)
+    check("gateway_success_rate", "gateway_success_rate",
+          args.min_success_rate, ge)
     for cls, budget in (args.class_p99_budgets or {}).items():
         # gateway per-class tail budget (docs/serving.md): asserted
         # over the source="gateway" request records' per-class p99.
@@ -124,6 +135,7 @@ def main(argv=None):
     ap.add_argument("--max-skipped-steps", type=float, default=None)
     ap.add_argument("--max-anomalies", type=float, default=None)
     ap.add_argument("--max-cold-start-s", type=float, default=None)
+    ap.add_argument("--min-success-rate", type=float, default=None)
     ap.add_argument("--max-p99-ms-class", action="append", default=None,
                     metavar="CLASS=MS",
                     help="per-priority-class gateway p99 latency "
@@ -154,7 +166,7 @@ def main(argv=None):
                args.max_compiles, args.min_samples_per_sec,
                args.max_data_wait_frac, args.max_skipped_steps,
                args.max_anomalies, args.max_cold_start_s,
-               args.class_p99_budgets or None)
+               args.min_success_rate, args.class_p99_budgets or None)
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"
         print(json.dumps(verdict))

@@ -76,6 +76,31 @@ vs resident-hit latency is the record's eviction story:
                "reload": {"reloads": .., "reload_p95_ms": ..,
                           "hit_p50_ms": ..}}}
 
+`--mode chaos` is the serving-resilience soak (ISSUE-14,
+docs/fault_tolerance.md "Serving resilience"): replica 0 of an
+N-worker `ModelServer` is wedged via the replica-addressed
+``serving.replica0.dispatch`` hang site while closed-loop clients with
+per-request deadlines keep offering load, with the dispatch watchdog
+armed. The record asserts the resilience invariants:
+
+    {"metric": "serving_chaos_soak", "value": <success_rate>,
+     "unit": "frac",
+     "extra": {"invariants_ok": true, "no_late_resolution": true,
+               "availability_ok": true, "availability_floor": 0.5,
+               "quarantined": true, "readmitted": true,
+               "watchdog_trips": .., "watchdog_overhead_p50_pct": ..,
+               "parity_watchdog_off": true, ...}}
+
+- no request (success OR typed failure) resolves later than
+  deadline + watchdog budget + grace;
+- >= (N-1)/N of the offered load succeeds during the wedge (tripped
+  batches re-dispatch to surviving replicas);
+- the wedged replica is quarantined, then canary-re-admitted once the
+  injected fault clears — visible in `serving.replica.state` /
+  quarantine/readmit counters and `resilience.watchdog.trips`;
+- the watchdog-off path is output-identical, and the armed p50
+  overhead is measured.
+
 Env knobs (flags win): MXTPU_SERVE_BENCH_CLIENTS (16),
 MXTPU_SERVE_BENCH_REQUESTS (640 total), MXTPU_SERVE_BENCH_SERIAL (160),
 MXTPU_SERVE_BENCH_FEATURES (256), MXTPU_SERVE_BENCH_HIDDEN (256),
@@ -90,6 +115,14 @@ MXTPU_SERVE_BENCH_GATEWAY_INTERACTIVE/BATCH/FLOOD clients (2/2/8),
 MXTPU_SERVE_BENCH_GATEWAY_CONCURRENCY (2),
 MXTPU_SERVE_BENCH_GATEWAY_QUEUE (4),
 MXTPU_SERVE_BENCH_GATEWAY_ROUNDS (reload-storm cycles, 4).
+Chaos knobs: MXTPU_SERVE_BENCH_CHAOS_WORKERS (2 replicas),
+MXTPU_SERVE_BENCH_CHAOS_CLIENTS (4), MXTPU_SERVE_BENCH_CHAOS_REQUESTS
+(12 per client), MXTPU_SERVE_BENCH_CHAOS_TRIPS (trip limit, 2),
+MXTPU_SERVE_BENCH_CHAOS_TIMEOUT_S (dispatch watchdog, 0.4),
+MXTPU_SERVE_BENCH_CHAOS_DEADLINE_S (per-request deadline, 2.0),
+MXTPU_SERVE_BENCH_CHAOS_GRACE_S (scheduling slack atop the watchdog
+budget in the no-late-resolution invariant, 1.0 — raise it on a
+loaded CI box; a real hang overshoots any slack).
 Decode knobs: MXTPU_SERVE_BENCH_DECODE_SEQS (24 prompts),
 MXTPU_SERVE_BENCH_DECODE_SLOTS (8 cache slots),
 MXTPU_SERVE_BENCH_DECODE_NEW (16 tokens/request),
@@ -453,26 +486,220 @@ def run_coldstart(args_ns):
     }
 
 
+def run_chaos(args_ns):
+    """The serving-resilience soak (module docstring): wedge one of N
+    forward replicas with the replica-addressed hang chaos site, keep
+    deadline-carrying closed-loop load flowing, and assert the
+    quarantine → canary-readmission sequence plus the latency and
+    availability floors — all visible in metrics."""
+    from mxnet_tpu.observability import registry as _reg
+    from mxnet_tpu.resilience import Deadline, chaos
+    from mxnet_tpu.serving import InferenceEngine, ModelServer
+
+    workers = max(2, _env_int("MXTPU_SERVE_BENCH_CHAOS_WORKERS", 2))
+    clients = _env_int("MXTPU_SERVE_BENCH_CHAOS_CLIENTS", 4)
+    per_client = _env_int("MXTPU_SERVE_BENCH_CHAOS_REQUESTS", 12)
+    trip_limit = _env_int("MXTPU_SERVE_BENCH_CHAOS_TRIPS", 2)
+    wd_timeout = float(os.environ.get(
+        "MXTPU_SERVE_BENCH_CHAOS_TIMEOUT_S", "0.4"))
+    deadline_s = float(os.environ.get(
+        "MXTPU_SERVE_BENCH_CHAOS_DEADLINE_S", "2.0"))
+    # watchdog budget + scheduling slack. The slack is env-tunable: on
+    # a loaded single-core CI box thread scheduling alone can add
+    # seconds; the invariant stays meaningful at any slack — an
+    # unguarded hang would blow past it by the full hang duration
+    grace_s = wd_timeout + float(os.environ.get(
+        "MXTPU_SERVE_BENCH_CHAOS_GRACE_S", "1.0"))
+
+    os.environ["MXTPU_SERVE_TRIP_LIMIT"] = str(trip_limit)
+    os.environ.setdefault("MXTPU_SERVE_CANARY_S", "0.1")
+    os.environ["MXTPU_SERVE_DISPATCH_TIMEOUT_S"] = "0"
+
+    sym, params = _build_model(args_ns.features, args_ns.hidden)
+    engine = InferenceEngine.from_symbol(
+        sym, params, {}, {"data": (args_ns.features,)},
+        max_batch_size=8, name="chaos_bench")
+    server = ModelServer(engine, num_workers=workers, max_wait_ms=1.0,
+                         warmup=True).start()
+    rng = np.random.RandomState(11)
+    xs = rng.randn(64, args_ns.features).astype(np.float32)
+
+    def p50_probe(n=30):
+        lats = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            server.infer(xs[i % len(xs)][None], timeout=30)
+            lats.append(time.perf_counter() - t0)
+        return _percentile_ms(lats, 0.50)
+
+    try:
+        # -- watchdog-off vs armed: bit-identical outputs + p50 cost --
+        base_out = np.asarray(server.infer(xs[0:1], timeout=30)[0])
+        base_p50 = min(p50_probe() for _ in range(3))
+        os.environ["MXTPU_SERVE_DISPATCH_TIMEOUT_S"] = str(wd_timeout)
+        armed_out = np.asarray(server.infer(xs[0:1], timeout=30)[0])
+        armed_p50 = min(p50_probe() for _ in range(3))
+        parity = bool(np.array_equal(base_out, armed_out))
+        overhead_pct = (100.0 * (armed_p50 - base_p50) / base_p50
+                        if base_p50 > 0 else 0.0)
+
+        def total(name):
+            m = _reg.REGISTRY.get(name)
+            return float(m.total()) if m is not None else 0.0
+
+        # race-free evidence for the state sequence: the instantaneous
+        # worker state can flip quarantined -> healthy between polls
+        # (the canary is fast), but the cumulative counters only move
+        # forward
+        q_before = total("serving.replica.quarantines")
+        r_before = total("serving.replica.readmits")
+        t_before = total("serving.replica.trips")
+
+        # -- wedge replica 0: trips to quarantine, one canary trip,
+        # then the site exhausts (the fault "clears") and the next
+        # canary re-admits — fully deterministic
+        n_hangs = trip_limit + 1
+        chaos.configure("serving.replica0.dispatch:kind=hang,"
+                        "secs=%g,n=%d" % (wd_timeout * 10, n_hangs))
+
+        lock = threading.Lock()
+        lats, ok, failed, errors = [], [0], [0], []
+
+        def client(idx):
+            for i in range(per_client):
+                x = xs[(idx * per_client + i) % len(xs)][None]
+                t0 = time.perf_counter()
+                try:
+                    h = server.submit(
+                        x, deadline=Deadline(deadline_s,
+                                             what="chaos request"))
+                    h.result(timeout=deadline_s + grace_s + 30)
+                    good = True
+                except Exception as err:  # noqa: BLE001 — recorded
+                    good = False
+                    with lock:
+                        errors.append(type(err).__name__)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lats.append(dt)
+                    (ok if good else failed)[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        def was_quarantined():
+            return total("serving.replica.quarantines") > q_before
+
+        # -- keep pressure on until the wedged replica has drawn its
+        # trip limit (the burst alone can finish too fast on an
+        # otherwise-idle box); these are load too, so they ride the
+        # same tallies and latency bound
+        extra = [0]
+        t_give_up = time.monotonic() + 60
+        while not was_quarantined() and time.monotonic() < t_give_up:
+            client(clients + extra[0])   # one more closed-loop pass
+            extra[0] += 1
+        quarantined = was_quarantined()
+        wedge_wall = time.perf_counter() - t0
+
+        # -- watch the state machine finish: canary-re-admitted once
+        # the injected hangs are exhausted
+        readmitted = False
+        t_give_up = time.monotonic() + 60
+        while quarantined and time.monotonic() < t_give_up:
+            if total("serving.replica.readmits") > r_before:
+                readmitted = True
+                break
+            time.sleep(0.05)
+
+        trips = total("serving.replica.trips") - t_before
+        stats = server.stats()
+    finally:
+        chaos.reset()
+        server.drain(timeout=60)
+        os.environ["MXTPU_SERVE_DISPATCH_TIMEOUT_S"] = "0"
+
+    offered = (clients + extra[0]) * per_client
+    success_rate = ok[0] / float(offered) if offered else 0.0
+    floor = (workers - 1) / float(workers)
+    max_lat = max(lats) if lats else 0.0
+    inv = {
+        "no_late_resolution": bool(max_lat <= deadline_s + grace_s),
+        "availability_ok": bool(success_rate >= floor),
+        "quarantined": bool(quarantined),
+        "readmitted": bool(readmitted),
+        "trips_counted": bool(trips >= trip_limit),
+        "parity_watchdog_off": parity,
+    }
+    return {
+        "metric": "serving_chaos_soak",
+        "value": round(success_rate, 4), "unit": "frac",
+        "extra": {
+            "invariants_ok": bool(all(inv.values())),
+            **inv,
+            "workers": workers, "offered": offered,
+            "succeeded": ok[0], "failed": failed[0],
+            "error_types": sorted(set(errors)),
+            "availability_floor": floor,
+            "max_resolution_s": round(max_lat, 4),
+            "deadline_s": deadline_s, "grace_s": grace_s,
+            "watchdog_timeout_s": wd_timeout,
+            "trip_limit": trip_limit,
+            "watchdog_trips": trips,
+            "quarantines": total("serving.replica.quarantines")
+            - q_before,
+            "readmits": total("serving.replica.readmits") - r_before,
+            "wedge_wall_s": round(wedge_wall, 4),
+            "watchdog_overhead_p50_pct": round(overhead_pct, 2),
+            "p50_off_ms": round(base_p50, 3),
+            "p50_armed_ms": round(armed_p50, 3),
+            "worker_states": [
+                {"index": w["index"], "state": w["state"],
+                 "alive": w["alive"], "trips": w["trips"]}
+                for w in stats["workers"]],
+        },
+    }
+
+
 def _http_post(url, payload, timeout=120):
     """POST JSON over the real wire; returns (status, parsed body,
     latency_s). Shed/error statuses come back as values, not raises —
-    the bench records them."""
+    the bench records them. A `Retry-After` header (the gateway's
+    backpressure hint) rides the body as ``_retry_after`` so
+    closed-loop clients can back off like real callers."""
     import urllib.error
     import urllib.request
     data = json.dumps(payload).encode("utf-8")
     req = urllib.request.Request(
         url, data=data, headers={"Content-Type": "application/json"})
     t0 = time.perf_counter()
+
+    def stamp(body, headers):
+        ra = headers.get("Retry-After") if headers else None
+        if ra is not None:
+            try:
+                body["_retry_after"] = float(ra)
+            except ValueError:
+                pass
+        return body
+
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             body = json.loads(r.read().decode("utf-8"))
-            return r.status, body, time.perf_counter() - t0
+            return r.status, stamp(body, r.headers), \
+                time.perf_counter() - t0
     except urllib.error.HTTPError as err:
         try:
             body = json.loads(err.read().decode("utf-8"))
         except ValueError:
             body = {}
-        return err.code, body, time.perf_counter() - t0
+        return err.code, stamp(body, err.headers), \
+            time.perf_counter() - t0
     except (urllib.error.URLError, ConnectionError, OSError) as err:
         # a dropped/reset connection must not kill the client thread —
         # it would silently truncate the offered load and fake the
@@ -576,7 +803,13 @@ def run_gateway(args_ns):
                     else:
                         errors.append((st, body))
                 if st != 200:
-                    time.sleep(0.001)   # don't spin on instant sheds
+                    # honor the gateway's Retry-After backpressure
+                    # hint (scaled down to bench time: the POINT is
+                    # that shed clients stop retry-storming), with a
+                    # floor so instant sheds never spin
+                    ra = body.get("_retry_after")
+                    time.sleep(min(float(ra) * 0.01, 0.05)
+                               if ra else 0.001)
                 i += 1
 
         floods = [threading.Thread(target=flood_client, args=(i,))
@@ -675,7 +908,7 @@ def main(argv=None):
                     "(closed/open/decode/coldstart)")
     parser.add_argument("--mode",
                         choices=("closed", "open", "both", "decode",
-                                 "coldstart", "gateway"),
+                                 "coldstart", "gateway", "chaos"),
                         default="closed")
     parser.add_argument("--gateway-p99-budget-ms", type=float,
                         default=float(os.environ.get(
@@ -731,6 +964,12 @@ def main(argv=None):
 
     if args_ns.mode == "gateway":
         record = run_gateway(args_ns)
+        record["platform"] = jax.default_backend()
+        print(json.dumps(record))
+        return 0
+
+    if args_ns.mode == "chaos":
+        record = run_chaos(args_ns)
         record["platform"] = jax.default_backend()
         print(json.dumps(record))
         return 0

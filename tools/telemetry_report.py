@@ -172,9 +172,14 @@ def summarize(records):
             summary["optimizer_p95_s"] = _percentile(opt_times, 0.95)
     # serving section (docs/serving.md): per-batch records ModelServer
     # workers emit with source="serving" — step_time is the batch's
-    # service time, shed_total the batcher's cumulative shed counter
+    # service time, shed_total the batcher's cumulative shed counter.
+    # Resilience EVENTS (replica_state/worker_death/loop_crash/
+    # breaker/hedge) ride the same source with an "event" field and
+    # are summarized separately below — their zero step_times must
+    # not dilute the batch service percentiles
     serving = [r for r in records
-               if str(r.get("source", "")).startswith("serving")]
+               if str(r.get("source", "")).startswith("serving")
+               and r.get("event") is None]
     if serving:
         svc = sorted(float(r["step_time"]) for r in serving)
         reqs = sum(int(r.get("requests", 0)) for r in serving)
@@ -193,6 +198,36 @@ def summarize(records):
             int(r.get("queue_depth", 0)) for r in serving)
         summary["serving_shed"] = max(
             int(r.get("shed_total", 0)) for r in serving)
+    # serving-resilience section (docs/fault_tolerance.md "Serving
+    # resilience"): source="serving" events from the replica health
+    # machine, the decode loop-crash fix, the gateway breaker, and
+    # hedged requests — the sequence a chaos drill must leave behind
+    sres = [r for r in records if r.get("source") == "serving"
+            and r.get("event") is not None]
+    if sres:
+        states = [r for r in sres if r.get("event") == "replica_state"]
+        summary["serving_quarantines"] = sum(
+            1 for r in states if r.get("state") == "quarantined")
+        summary["serving_readmits"] = sum(
+            1 for r in states if r.get("state") == "healthy"
+            and r.get("reason") == "canary")
+        summary["serving_replicas_dead"] = sum(
+            1 for r in states if r.get("state") == "dead")
+        summary["serving_worker_deaths"] = sum(
+            1 for r in sres if r.get("event") == "worker_death")
+        summary["serving_loop_crashes"] = sum(
+            1 for r in sres if r.get("event") == "loop_crash")
+        breakers = [r for r in sres if r.get("event") == "breaker"]
+        if breakers:
+            summary["breaker_opens"] = sum(
+                1 for r in breakers if r.get("state") == "open")
+            summary["breaker_models"] = sorted(
+                {str(r.get("model", "?")) for r in breakers})
+        hedges = [r for r in sres if r.get("event") == "hedge"]
+        if hedges:
+            summary["hedges_fired"] = len(hedges)
+            summary["hedges_won"] = sum(
+                1 for r in hedges if r.get("won"))
     # decode section (docs/serving.md): ContinuousBatchScheduler emits
     # one record per decode step (step_time = whole-batch step service
     # time) and one per finished request (event="request", with TTFT
@@ -250,6 +285,13 @@ def summarize(records):
         summary["gateway_requests"] = len(gw_reqs)
         summary["gateway_sheds"] = len(gw_sheds)
         summary["gateway_errors"] = len(gw_errors)
+        # success rate for perf_gate --min-success-rate: served over
+        # served+errors. Sheds are EXCLUDED by design — explicit
+        # backpressure (503/504 + Retry-After) is the system working,
+        # server-side errors are it failing
+        denom = len(gw_reqs) + len(gw_errors)
+        summary["gateway_success_rate"] = (
+            len(gw_reqs) / denom if denom else 1.0)
         summary["gateway_models"] = sorted(
             {str(r.get("model", "?")) for r in gw_reqs})
         for cls in sorted({str(r.get("class", "?")) for r in gw_reqs}):
@@ -492,6 +534,9 @@ def format_summary(s):
                s.get("gateway_reloads", 0),
                ("  reload max %.3fs" % s["gateway_reload_max_s"]
                 if "gateway_reload_max_s" in s else "")))
+        lines.append(
+            "              success rate %.1f%% (sheds excluded)"
+            % (100.0 * s.get("gateway_success_rate", 1.0)))
         for cls in ("interactive", "batch", "best_effort"):
             if ("gateway_%s_requests" % cls) in s:
                 lines.append(
@@ -502,6 +547,25 @@ def format_summary(s):
                        s["gateway_%s_p95_ms" % cls],
                        s["gateway_%s_p99_ms" % cls],
                        s.get("gateway_shed_by_class", {}).get(cls, 0)))
+    if "serving_quarantines" in s or "breaker_opens" in s \
+            or "hedges_fired" in s:
+        lines.append(
+            "  resilience  %d quarantine(s)  %d readmit(s)  "
+            "%d worker death(s)  %d loop crash(es)  %d dead"
+            % (s.get("serving_quarantines", 0),
+               s.get("serving_readmits", 0),
+               s.get("serving_worker_deaths", 0),
+               s.get("serving_loop_crashes", 0),
+               s.get("serving_replicas_dead", 0)))
+        if s.get("breaker_opens") is not None:
+            lines.append(
+                "              breaker opened %d time(s) (models %s)"
+                % (s.get("breaker_opens", 0),
+                   ", ".join(s.get("breaker_models", []))))
+        if s.get("hedges_fired"):
+            lines.append(
+                "              hedges fired %d  won %d"
+                % (s["hedges_fired"], s.get("hedges_won", 0)))
     if s.get("skipped_steps") or s.get("anomalies") \
             or s.get("numerics_rollbacks") or s.get("sdc_suspected") \
             or "loss_scale_last" in s:
