@@ -764,6 +764,12 @@ def _measure_main():
         # failure
         "skipped_steps": int(getattr(st, "bench_skipped_steps", 0)),
         "anomalies": int(getattr(st, "bench_anomalies", 0)),
+        # fused-step provenance (docs/performance.md "Fused train step
+        # & ZeRO-1"): the measured loop is the one-program-per-step
+        # ShardedTrainer path; zero1 records whether optimizer state
+        # was ZeRO-1-sharded over dp (MXTPU_ZERO1) for this number
+        "fused_step": True,
+        "zero1": bool(getattr(st, "_shard_opt", False)),
         "extra": extra}))
 
 

@@ -15,12 +15,22 @@ Internally the sync strategy is resolved ONCE into two booleans
 (_reduce_via_kv / _update_via_kv) by _resolve_sync(), and every
 gradient walk goes through _trainable() — a different decomposition
 from the reference's per-call branching.
+
+Fused one-program step (docs/performance.md "Fused train step &
+ZeRO-1", default on): `step()` runs gradient exchange + optimizer
+update as ONE donated jit program (parallel/fused_step.py) — no
+host-visible buffers or Python between the phases, recorded as a
+single "step" phase in telemetry. ``MXTPU_FUSED_STEP=0``, unsupported
+optimizers, compression, or update-on-kvstore fall back to the staged
+bucketed path below (the bit-parity oracle); `allreduce_grads()` /
+`update()` always take the staged halves, unchanged.
 """
 from __future__ import annotations
 
 from .. import optimizer as opt
 from ..kvstore import create as _create_kvstore
 from ..observability.telemetry import StepTimer
+from ..parallel import fused_step as _fstep
 from ..resilience import numerics as _numerics
 from ..resilience.atomic import atomic_write
 from ..resilience.preempt import at_step_boundary
@@ -166,7 +176,11 @@ class Trainer:
     # -- the step -------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: reduce grads, then update params
-        (reference: trainer.py:241)."""
+        (reference: trainer.py:241). With ``MXTPU_FUSED_STEP`` (default
+        on) both phases run as ONE donated jit program — the gradient
+        exchange and the fused update share an XLA computation, so the
+        telemetry record carries a single "step" phase and
+        `train.step.dispatches` reads exactly 1."""
         # step boundary: params/opt-state are consistent here, so a
         # pending SIGTERM checkpoints and stops BEFORE new work starts
         # (resilience/preempt.py)
@@ -175,12 +189,62 @@ class Trainer:
         tel = self._telemetry
         tel.begin_step()
         self._optimizer.rescale_grad = self._rescale(batch_size)
-        with tel.phase("allreduce"):
-            self._reduce()
-        with tel.phase("optimizer"):
-            self._apply_updates(ignore_stale_grad)
+        if not self._fused_step(ignore_stale_grad, tel):
+            with tel.phase("allreduce"):
+                self._reduce()
+            with tel.phase("optimizer"):
+                self._apply_updates(ignore_stale_grad)
         self._numerics_boundary(tel)
         tel.end_step(batch_size=batch_size)
+
+    def _fused_step(self, ignore_stale_grad, tel):
+        """Try the one-program exchange+update step
+        (parallel/fused_step.py). Returns True when it ran; False falls
+        back to the staged bucketed path with nothing mutated.
+
+        ZeRO-1 note (docs/performance.md): with ``MXTPU_ZERO1=1`` in a
+        multi-process run, `save_states`/`get_states` all-gathers the
+        sharded optimizer state — a COLLECTIVE every rank must enter;
+        a rank-0-only save_states would deadlock (save through
+        `parallel.TrainerCheckpoint` or call it on every rank)."""
+        if not _fstep.enabled() or self._update_via_kv:
+            return False
+        kv = self._kvstore if self._reduce_via_kv else None
+        multi = getattr(kv, "num_workers", 1) > 1
+        if ignore_stale_grad and multi:
+            # freshness is RANK-LOCAL: filtering collective membership
+            # by it would desynchronize the SPMD program across ranks
+            # (the staged path always exchanges the full trainable
+            # set) — staged, unconditionally
+            return False
+        pairs = self._trainable()
+        if ignore_stale_grad:
+            pairs = [(i, p) for i, p in pairs if p.grad()._fresh_grad]
+        if not pairs:
+            return True      # nothing to update: zero dispatches
+        idxs = [i for i, _ in pairs]
+        # cheap latched pre-check BEFORE the phase opens: permanently
+        # staged runs (RMSProp, compression, refused key sets) must
+        # not emit a bogus "step" trace span every iteration
+        if not _fstep.eligible(self._updaters[0], idxs, kvstore=kv):
+            return False
+        grads = [p.grad() for _, p in pairs]
+        with tel.phase("step"):
+            ran = _fstep.try_step(
+                self._updaters[0], idxs, grads,
+                [p.data() for _, p in pairs], kvstore=kv)
+        if not ran:
+            # first-time collect refusal (now latched): drop the empty
+            # phase so the staged record keeps its shape
+            tel._phases.pop("step", None)
+            return False
+        if self._numerics is not None:
+            # kept for the boundary's SDC replay digest (grads are not
+            # donated — the packed exchange consumed copies)
+            self._last_grads = grads
+        for g in grads:
+            g._fresh_grad = False
+        return True
 
     def _rescale(self, batch_size):
         """rescale_grad for this step: the caller's scale over the

@@ -212,6 +212,11 @@ def _counters_snapshot():
         # tools/perf_gate.py fail a silently-skipping run
         "skipped_steps": _counter_total("numerics.skipped_steps"),
         "anomalies": _counter_total("numerics.anomalies"),
+        # fused train step (parallel/fused_step.py): device programs
+        # dispatched for exchange+update — 1/step on the fused path,
+        # O(buckets)+O(groups) staged; perf_gate budgets it via
+        # --max-dispatches-per-step
+        "step_dispatches": _counter_total("train.step.dispatches"),
     }
 
 
@@ -347,7 +352,7 @@ class StepTimer:
                       "bucket_unpack_seconds", "update_dispatches",
                       "fused_groups", "fused_pack_seconds",
                       "fused_update_seconds", "skipped_steps",
-                      "anomalies"):
+                      "anomalies", "step_dispatches"):
             delta = snap[field] - prev.get(field, 0)
             if delta:
                 record[field] = delta

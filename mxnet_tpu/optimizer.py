@@ -37,6 +37,10 @@ __all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "Adam", "AdaGrad",
 _UPDATE_DISPATCHES = _obs.counter(
     "optimizer.update.dispatches",
     "Optimizer update computations dispatched (per-param + fused-group)")
+# per-key updates also count toward the step's device-program budget
+# (registered+documented in parallel/fused_step.py; name-based here to
+# avoid an import cycle)
+_STEP_DISPATCHES = _obs.counter("train.step.dispatches")
 
 def donate_update_enabled():
     """Buffer donation for the update jits (weights/optimizer state
@@ -760,6 +764,7 @@ class Updater:
                 self.states[index], weight._ctx)
             self.states_synced[index] = True
         _UPDATE_DISPATCHES.inc()
+        _STEP_DISPATCHES.inc()
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 

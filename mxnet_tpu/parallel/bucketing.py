@@ -92,7 +92,7 @@ class Bucket:
     offsets into the flat buffer."""
 
     __slots__ = ("dtype", "lane", "keys", "shapes", "offsets", "sizes",
-                 "total", "first_pos", "best_priority")
+                 "total", "first_pos", "best_priority", "_sig")
 
     def __init__(self, dtype, lane, first_pos, priority):
         self.dtype = np.dtype(dtype)
@@ -104,6 +104,7 @@ class Bucket:
         self.total = 0
         self.first_pos = first_pos
         self.best_priority = priority
+        self._sig = None
 
     def add(self, key, shape, size):
         self.keys.append(key)
@@ -111,6 +112,7 @@ class Bucket:
         self.offsets.append(self.total)
         self.sizes.append(int(size))
         self.total += int(size)
+        self._sig = None
 
     @property
     def nbytes(self):
@@ -119,9 +121,13 @@ class Bucket:
     @property
     def signature(self):
         """Hashable layout identity: what per-bucket state (e.g. a
-        compression residual) must be keyed by."""
-        return (str(self.dtype), self.lane,
-                tuple(zip(self.keys, self.shapes)))
+        compression residual or a fused-step program cache) must be
+        keyed by. Cached — hot paths read it per step on memoized
+        plans whose membership never changes."""
+        if self._sig is None:
+            self._sig = (str(self.dtype), self.lane,
+                         tuple(zip(self.keys, self.shapes)))
+        return self._sig
 
     def pack(self, grads):
         """Concatenate raveled per-key gradients (in bucket order) into
@@ -188,6 +194,24 @@ class GradBucketer:
         buckets.sort(key=lambda b: (-b.best_priority, b.first_pos))
         self._plans[items] = buckets
         return buckets
+
+    def plan_signature(self, items_or_buckets):
+        """Stable, process-independent fingerprint of a bucket layout:
+        sha256 over the ordered `Bucket.signature`s plus the target
+        size. `items_or_buckets` is either a `plan()` items tuple or an
+        already-planned bucket list. AOT fingerprints
+        (parallel/fused_update.py, parallel/fused_step.py) include it
+        so a bucket-layout change (MXTPU_BUCKET_MB, membership, key
+        order) is a counted JIT fallback — never a wrong-program
+        load."""
+        import hashlib
+        seq = list(items_or_buckets)
+        if seq and not isinstance(seq[0], Bucket):
+            seq = self.plan(tuple(seq))
+        h = hashlib.sha256(str(self.target_bytes).encode())
+        for b in seq:
+            h.update(repr(b.signature).encode())
+        return h.hexdigest()[:16]
 
     def clear(self):
         self._plans.clear()

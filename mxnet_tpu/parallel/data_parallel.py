@@ -38,6 +38,7 @@ from ..graph import build_graph_fn, collect_vars
 from .. import random as _random
 from ..resilience import numerics as _num
 from ..resilience.preempt import at_step_boundary
+from . import fused_step as _fstep
 from .mesh import make_mesh, replicated, current_mesh
 
 __all__ = ["ShardedTrainer", "sgd_init", "sgd_update", "adam_init",
@@ -119,7 +120,7 @@ class ShardedTrainer:
                  data_names=("data",), label_names=("label",),
                  aux_mode="train", compute_dtype=None,
                  gradient_compression=None,
-                 shard_optimizer_state=False, remat=False,
+                 shard_optimizer_state=None, remat=False,
                  input_specs=None):
         """compute_dtype: e.g. "bfloat16" for mixed precision — master
         params stay fp32; weights (ndim>=2) and data inputs are cast to
@@ -129,11 +130,13 @@ class ShardedTrainer:
         state stay fp32; grads accumulate fp32.
 
         shard_optimizer_state: weight-update sharding (SURVEY §2.3,
-        the XLA sharding paper's ZeRO-1-style trick): optimizer state
-        (momentum / adam m,v) shards row-wise over the dp axis instead
-        of replicating, cutting its memory to 1/n per device. The
-        partitioner reduce-scatters gradients into the sharded update
-        and re-gathers weights — same numerics, tested.
+        ZeRO-1, arXiv:2004.13336): optimizer state (momentum / adam
+        m,v) shards row-wise over the dp axis instead of replicating,
+        cutting its memory to 1/n per device. The partitioner
+        reduce-scatters gradients into the sharded update and
+        re-gathers weights — same numerics, tested. Defaults to the
+        ``MXTPU_ZERO1`` env knob (parallel/fused_step.py) when None;
+        an explicit bool wins.
 
         gradient_compression: e.g. {"type": "2bit", "threshold": 0.5} —
         the data-parallel gradient exchange becomes an explicit
@@ -155,6 +158,14 @@ class ShardedTrainer:
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
         self._grad_compression = None
+        if shard_optimizer_state is None:
+            # MXTPU_ZERO1 (docs/performance.md "Fused train step &
+            # ZeRO-1"): weight-update sharding by environment, the
+            # same knob the gluon.Trainer fused step honors — except
+            # under gradient compression, whose step keeps replicated
+            # state (an env default must not turn into a hard error)
+            shard_optimizer_state = (_fstep.zero1_enabled()
+                                     and gradient_compression is None)
         if gradient_compression is not None:
             gc = dict(gradient_compression)
             if gc.get("type", "2bit") != "2bit":
@@ -387,6 +398,10 @@ class ShardedTrainer:
                                                PartitionSpec(dp))
                 else:
                     zero_sh[n] = param_sh[n]
+            _fstep.ZERO1_SHARD_PARAMS.set(sum(
+                1 for n in self._params
+                if zero_sh[n].spec != PartitionSpec()
+                and self._spec_for(n) == PartitionSpec()))
             opt_sh = _match_param_shardings(self._opt_state, zero_sh,
                                             rep)
         else:
@@ -398,6 +413,13 @@ class ShardedTrainer:
         return param_sh, aux_sh, opt_sh, in_sh, rep
 
     def _build_step(self):
+        # the ONE program per training step (ROADMAP open item 1):
+        # forward + backward + XLA-inserted gradient collectives +
+        # optimizer update in a single donated pjit. Builds run under
+        # the persistent compilation cache (PR 11) so gang relaunches
+        # and rollback restarts reload instead of re-tracing XLA.
+        from ..compile.cache import enable_cache
+        enable_cache()
         step = self._make_step_body()
         param_sh, aux_sh, opt_sh, in_sh, rep = self._shardings()
         self._step_fn = jax.jit(
@@ -413,6 +435,8 @@ class ShardedTrainer:
         on high-latency links (dev tunnels, multi-host controllers) the
         per-call round trip amortizes away; on any TPU it removes K-1
         host dispatches."""
+        from ..compile.cache import enable_cache
+        enable_cache()   # program build is a compile entry point
         # the scan body is UNGUARDED (see _make_step_body: per-step
         # selects inside the while loop explode XLA compile); the
         # window is guarded once OUTSIDE the loop instead — a NaN step
@@ -488,6 +512,7 @@ class ShardedTrainer:
              ok) = self._step_many_fn(
                 self._params, self._aux, self._opt_state,
                 inputs, key, int(n_steps), int(unroll))
+        _fstep.STEP_DISPATCHES.inc()   # K steps, ONE scanned program
         if _num.enabled():
             # one scalar verdict for the whole fused window — recorded
             # as where="window": DETECTION-only (the scan body is
@@ -710,6 +735,7 @@ class ShardedTrainer:
                 (self._params, self._aux, self._opt_state,
                  loss, ok) = self._step_fn(
                     self._params, self._aux, self._opt_state, inputs, key)
+        _fstep.STEP_DISPATCHES.inc()   # the whole step was ONE program
         if _num.enabled():
             _num.record_flag(ok, where="step")
         self._step_count += 1

@@ -1,0 +1,603 @@
+"""One compiled program per training step + ZeRO-1 weight-update
+sharding (docs/performance.md "Fused train step & ZeRO-1").
+
+PR 3 collapsed the gradient exchange into a few bucketed collectives
+and PR 4 collapsed the weight update into a few donated group jits —
+but a `gluon.Trainer.step()` / `Module.update()` remained TWO
+host-orchestrated phases with host-visible buffers between them, and
+the reference framework's multi-machine story (arXiv:1512.01274) was
+still split across a kvstore hop. This module fuses **gradient
+exchange + optimizer update into ONE donated jit program**: the
+cross-replica sum (the kvstore allreduce) and the fused update kernels
+ride the same XLA computation, so XLA schedules the collective behind
+the update math and zero Python runs between the phases. Forward and
+backward already execute as one compiled program on every path
+(executor / CachedOp / ShardedTrainer), so a training step is now a
+single device program on the `ShardedTrainer` path and a single
+exchange+update program behind the imperative facades.
+
+On top rides **ZeRO-1 weight-update sharding** ("Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training",
+arXiv:2004.13336): with ``MXTPU_ZERO1=1`` the optimizer state and the
+update computation are sharded across the data-parallel axis
+(reduce-scatter grads -> shard-local fused update -> all-gather
+params, expressed as NamedSharding constraints the partitioner lowers
+onto the ring), cutting optimizer-state memory to 1/N per replica.
+Sharded state is carried as donated program state between steps and
+all-gathered only at the get_states/save boundaries
+(`zero1.allgather.seconds`). `ShardedTrainer` honors the same knob by
+defaulting `shard_optimizer_state` from ``MXTPU_ZERO1``.
+
+Numerics-guard contract (PR 9): the whole fused step body runs under
+ONE in-graph ``lax.cond`` — a step whose (post-exchange) gradients are
+not all finite is skipped with weights AND optimizer state preserved
+bit-identically, and the single verdict lands in the PR-9 flag
+collector as ``where="step"`` (a protected provenance: it counts as a
+skipped step, feeds the DivergenceWatchdog, and keeps SDC replay
+sound). The ``grad.post`` / ``weight.post`` chaos corruption sites of
+the staged path fire at the same places around the fused program.
+The guard is never applied inside a ``lax.scan`` — `step_many`'s
+post-scan window verdict stays as-is (see data_parallel.py).
+
+Bit parity: flats are packed with the SAME `GradBucketer` layout plans
+the staged `FusedUpdater` uses and updated by the SAME kernel
+functions, and the cross-replica sum is the same stacked `jnp.sum` the
+bucketed exchange issues — elementwise IEEE ops commute with
+concatenation, so the fused step is bit-identical to the staged path
+(asserted in tests/test_fused_step.py). ``MXTPU_FUSED_STEP=0``
+restores the staged bucketed path, which remains the parity oracle.
+
+Artifact subsystem (PR 11): program builds run under the persistent
+compilation cache, and single-device programs register with the
+``MXTPU_AOT_STORE`` exactly like the fused-update kernels — keyed by a
+fingerprint that includes the bucket-layout **plan signature**
+(`GradBucketer.plan_signature`), so a layout change is a counted JIT
+fallback, never a wrong-program load. `tools/aot_build.py --train`
+captures the step program by driving a tiny Trainer loop under
+``MXTPU_AOT_EXPORT=1``. Multi-device / multi-process programs never
+touch the store — a deserialized multi-device CPU executable can
+segfault jaxlib (the compile/cache.py guard).
+
+Env knobs:
+  MXTPU_FUSED_STEP   one-program step behind Trainer/Module (default 1)
+  MXTPU_ZERO1        shard optimizer state over the dp axis (default 0)
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..base import getenv
+from ..compile import aot as _aot
+from ..observability import registry as _obs
+from .. import optimizer as opt
+from ..resilience import numerics as _num
+from ..resilience.chaos import corrupt_point
+
+__all__ = ["FusedTrainStep", "enabled", "zero1_enabled", "try_step",
+           "eligible",
+           "STEP_DISPATCHES", "ZERO1_SHARD_PARAMS",
+           "ZERO1_ALLGATHER_SECONDS"]
+
+# every device program dispatched on behalf of a training step's
+# exchange/update work: ONE per fused step; O(buckets)+O(groups) on the
+# staged path (each bucket collective and each update jit counts). The
+# per-step delta rides StepTimer records and is the
+# perf_gate --max-dispatches-per-step budget.
+STEP_DISPATCHES = _obs.counter(
+    "train.step.dispatches",
+    "Device programs dispatched per training step for gradient "
+    "exchange + optimizer update (fused path: exactly 1)")
+ZERO1_SHARD_PARAMS = _obs.gauge(
+    "zero1.shard_params",
+    "Parameters whose optimizer state/update is ZeRO-1-sharded over "
+    "the data-parallel axis (0 = replicated state)")
+ZERO1_ALLGATHER_SECONDS = _obs.histogram(
+    "zero1.allgather.seconds",
+    "Wall time all-gathering ZeRO-1-sharded optimizer state into a "
+    "full copy (get_states / checkpoint / staged-fallback boundaries)")
+
+
+def enabled():
+    """MXTPU_FUSED_STEP gate, re-read per call (default on): the
+    one-program exchange+update step behind gluon.Trainer and
+    Module.update. 0 restores the staged bucketed path."""
+    return getenv("MXTPU_FUSED_STEP", True)
+
+
+def zero1_enabled():
+    """MXTPU_ZERO1 gate, re-read per call (default off): shard
+    optimizer state + the weight update over the data-parallel axis."""
+    return getenv("MXTPU_ZERO1", False)
+
+
+# fused-step-eligible optimizer classes: the parity-contract set whose
+# kernels are pure elementwise expressions (bit-identical under any XLA
+# fusion context). RMSProp/AdaGrad keep the staged path — their
+# centered/eps codegen is fusion-sensitive (fused_update._guard_wrap)
+_STEP_OPTS = (opt.SGD, opt.Adam)
+
+
+class _Lane:
+    """One packed fusion buffer's worth of same-(cohort, lane) params
+    inside the fused step program."""
+
+    __slots__ = ("bucket", "group", "spec", "wd", "hyper", "lr", "t",
+                 "n_states")
+
+    def __init__(self, bucket, group, spec, lr, t, hyper, n_states):
+        self.bucket = bucket
+        self.group = group          # [_Entry] in bucket key order
+        self.spec = spec
+        self.wd = group[0].wd
+        self.hyper = hyper
+        self.lr = lr
+        self.t = t
+        self.n_states = n_states
+
+    @property
+    def key(self):
+        """Static program identity: kernel + hyperparameters + the
+        full bucket-layout signature (a layout change re-keys the
+        program — counted JIT fallback, never a stale load)."""
+        return (self.spec.name, self.bucket.signature, float(self.wd),
+                self.hyper)
+
+
+class FusedTrainStep:
+    """One donated program per imperative training step.
+
+    Owns nothing but program caches; parameter/optimizer state stays in
+    the caller's NDArrays (and the attached `FusedUpdater`'s state
+    dict), except ZeRO-1-sharded state flats which are carried as
+    donated program state between steps and flushed back on demand.
+    """
+
+    def __init__(self, updater):
+        from .fused_update import FusedUpdater
+        if not isinstance(updater, FusedUpdater):
+            raise TypeError("FusedTrainStep needs a FusedUpdater "
+                            "(optimizer.get_updater default)")
+        self._updater = updater
+        updater._fused_step_owner = self     # get_states flush hook
+        self._programs = {}       # signature -> callable
+        self._aot = {}            # signature -> exe | False
+        self._refused = set()     # program signatures latched staged
+        # FULL program signature -> (lanes_meta, [per-lane flats]):
+        # the ZeRO-1 carried state (authoritative until flushed). The
+        # key includes the zero1/guard/donate flags, so ANY knob
+        # toggled mid-run (MXTPU_ZERO1 off, donation off) mismatches
+        # and flushes instead of feeding sharded padded flats to a
+        # program traced for replicated unpadded ones
+        self._state_flats = {}
+        self._gather_fn = {}      # (shape, dtype, mesh) -> gather jit
+        self._gauge_val = None    # last zero1.shard_params value set
+
+    # -- public ----------------------------------------------------------
+    def program_count(self):
+        """Compiled step programs alive in this step object — the
+        jit-cache census hook (steady-state training holds exactly 1)."""
+        return len(self._programs)
+
+    def run(self, indices, grads, weights, kvstore=None):
+        """Run one fused exchange+update step over the whole trainable
+        set. Returns True when the fused program ran (gradient arrays
+        are left UNREDUCED — the program consumed packed copies);
+        False means the caller must take the staged path (no state was
+        mutated, no update counts were bumped)."""
+        from .fused_update import _SUPPORTED
+        o = self._updater.optimizer
+        spec = _SUPPORTED.get(type(o))
+        if spec is None or type(o) not in _STEP_OPTS or not indices:
+            return False
+        probe_key = (type(o), tuple(indices))
+        if probe_key in self._refused:
+            # a set that refused once (row-sparse key, unpackable
+            # leaves) refuses every step — don't re-run the full
+            # collection probe just to fall back again
+            return False
+        nproc, mesh = self._exchange_plan(kvstore)
+        if nproc is None:
+            return False
+        entries, _left = self._updater._collect(
+            spec, indices, grads, weights, require_all=True)
+        if entries is None:     # ineligible key: nothing was mutated
+            if len(self._refused) > 64:   # membership churn bound
+                self._refused.clear()
+            self._refused.add(probe_key)
+            return False
+        lanes = self._plan_lanes(spec, entries)
+        zero1 = zero1_enabled() and mesh is not None
+        guard = _num.enabled()
+        donate = opt.donate_update_enabled()
+        sig = (tuple(l.key for l in lanes), nproc, zero1, guard, donate)
+        if self._state_flats and sig not in self._state_flats:
+            # layout/cohort/knob change: re-materialize the carried
+            # state before the old flats' lane map goes stale
+            self.flush_state()
+        packed = self._pack(lanes, sig, nproc, mesh, zero1)
+        fn = self._program_for(sig, lanes, packed, nproc, mesh, zero1,
+                               guard, donate)
+        new_w, new_states, ok = fn(*packed)
+        STEP_DISPATCHES.inc()
+        n_sharded = sum(len(l.group) for l in lanes) if zero1 else 0
+        if n_sharded != self._gauge_val:
+            self._gauge_val = n_sharded
+            ZERO1_SHARD_PARAMS.set(n_sharded)
+        if guard:
+            keys = [e.index for l in lanes for e in l.group]
+            _num.record_flag(ok, keys=keys, where="step")
+        self._unpack(lanes, new_w, new_states, sig, nproc, zero1)
+        return True
+
+    def flush_state(self):
+        """All-gather any ZeRO-1-sharded state flats back into the
+        updater's per-key NDArrays (the get_states / save_states /
+        staged-fallback boundary). Collective: in a multi-process run
+        every rank must call it."""
+        if not self._state_flats:
+            return
+        t0 = time.perf_counter()
+        for _sig, (lanes_meta, flats) in \
+                list(self._state_flats.items()):
+            for (bucket, leaves_list, sizes), lane_flats in zip(
+                    lanes_meta, flats):
+                for s, flat in enumerate(lane_flats):
+                    full = self._replicate(flat)[:bucket.total]
+                    for leaves, sub in zip(leaves_list,
+                                           bucket.unpack(full)):
+                        leaves[s]._data = sub
+        self._state_flats.clear()
+        ZERO1_ALLGATHER_SECONDS.observe(time.perf_counter() - t0)
+
+    def drop_state(self):
+        """Forget carried state flats WITHOUT syncing (set_states just
+        replaced the authoritative per-key states)."""
+        self._state_flats.clear()
+
+    # -- exchange topology ----------------------------------------------
+    def _exchange_plan(self, kvstore):
+        return _exchange_plan(kvstore)
+
+    # -- lane planning ---------------------------------------------------
+    def _plan_lanes(self, spec, entries):
+        """Cohort + layout planning THROUGH the updater's own
+        `_plan_cohorts` — the exact generator the staged per-group
+        dispatch consumes, so the flats are byte-identical to the
+        staged path's by construction."""
+        o = self._updater.optimizer
+        hyper, n_states = spec.hyper(o), spec.n_states(o)
+        return [_Lane(bucket, group, spec, lr, t, hyper, n_states)
+                for bucket, group, t, lr, _wd
+                in self._updater._plan_cohorts(entries)]
+
+    # -- packing ---------------------------------------------------------
+    @staticmethod
+    def _zero1_pad(flat, nproc):
+        pad = (-int(flat.shape[0])) % nproc
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        return flat
+
+    def _pack(self, lanes, sig, nproc, mesh, zero1):
+        from .bucketing import PACK_SECONDS
+        t0 = time.perf_counter()
+        carried = self._state_flats.get(sig)
+        w_flats, g_flats, state_flats, lrs, ts = [], [], [], [], []
+        for i, lane in enumerate(lanes):
+            b, group = lane.bucket, lane.group
+            w = b.pack([e.pack_w for e in group])
+            g = b.pack([e.grad for e in group])
+            if g.dtype != w.dtype:
+                # multi-precision: ONE fp32 cast of the whole flat
+                # (elementwise, commutes with concat — parity holds)
+                g = g.astype(w.dtype)
+            # chaos corruption site, same as the staged fused update:
+            # kind=nan here must be caught by the in-program guard
+            g = corrupt_point("grad.post", g)
+            if zero1:
+                w = self._zero1_pad(w, nproc)
+                g = self._zero1_pad(g, nproc)
+            if carried is not None:
+                states = carried[1][i]      # sharded, donated carry
+            else:
+                states = tuple(
+                    b.pack([e.state_leaves[s]._data for e in group])
+                    for s in range(lane.n_states))
+                if zero1:
+                    states = tuple(self._zero1_pad(s, nproc)
+                                   for s in states)
+            # host scalars, traced weakly — the exact spelling of the
+            # staged per-group jits (fused_update._jit_for passes lr/t
+            # as python values), so math AND per-step host cost match
+            lr, t = lane.lr, lane.t
+            if nproc > 1:
+                w = self._to_global(w, mesh, PartitionSpec())
+                g = self._to_global(g[None], mesh,
+                                    PartitionSpec("proc"))
+                if carried is None:
+                    states = tuple(
+                        self._to_global_sharded(
+                            s, mesh, PartitionSpec("proc"))
+                        if zero1 else
+                        self._to_global(s, mesh, PartitionSpec())
+                        for s in states)
+                lr = self._to_global(jnp.float32(lr), mesh,
+                                     PartitionSpec())
+                t = self._to_global(jnp.int32(t), mesh,
+                                    PartitionSpec())
+            w_flats.append(w)
+            g_flats.append(g)
+            state_flats.append(states)
+            lrs.append(lr)
+            ts.append(t)
+        PACK_SECONDS.observe(time.perf_counter() - t0)
+        return (tuple(w_flats), tuple(g_flats), tuple(state_flats),
+                tuple(lrs), tuple(ts))
+
+    def _my_devices(self, mesh):
+        return [d for d in mesh.devices.flat
+                if d.process_index == jax.process_index()]
+
+    def _to_global(self, x, mesh, pspec):
+        """A host-local array -> global jax.Array over the proc mesh
+        (each process contributes its device's shard — the
+        kvstore_dist._cross_process_sum recipe)."""
+        sharding = NamedSharding(mesh, pspec)
+        x = jnp.asarray(x)
+        if pspec == PartitionSpec("proc"):
+            shape = (mesh.shape["proc"],) + tuple(x.shape[1:])
+        else:
+            shape = tuple(x.shape)
+        arrays = [jax.device_put(x, d) for d in self._my_devices(mesh)]
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays)
+
+    def _to_global_sharded(self, flat, mesh, pspec):
+        """A full host-local state flat -> ZeRO-1 global array; the
+        process device_puts ONLY its own 1/N slice."""
+        nproc = mesh.shape["proc"]
+        rank = jax.process_index()
+        shard = int(flat.shape[0]) // nproc
+        local = jnp.asarray(flat)[rank * shard:(rank + 1) * shard]
+        sharding = NamedSharding(mesh, pspec)
+        arrays = [jax.device_put(local, d)
+                  for d in self._my_devices(mesh)]
+        return jax.make_array_from_single_device_arrays(
+            tuple(flat.shape), sharding, arrays)
+
+    def _replicate(self, flat):
+        """All-gather one (possibly process-spanning) sharded flat into
+        a host-local full array (the flush collective)."""
+        if getattr(flat, "is_fully_addressable", True):
+            return jnp.asarray(flat)
+        mesh = flat.sharding.mesh
+        key = (tuple(flat.shape), str(flat.dtype), id(mesh))
+        fn = self._gather_fn.get(key)
+        if fn is None:
+            rep = NamedSharding(mesh, PartitionSpec())
+            fn = self._gather_fn[key] = jax.jit(lambda a: a + 0,
+                                                out_shardings=rep)
+        out = fn(flat)
+        return jnp.asarray(out.addressable_data(0))
+
+    # -- the program -----------------------------------------------------
+    def _program_for(self, sig, lanes, packed, nproc, mesh, zero1,
+                     guard, donate):
+        cached = self._programs.get(sig)
+        if cached is not None:
+            return cached
+        from ..compile.cache import enable_cache
+        enable_cache()          # program build is a compile entry point
+        statics = tuple((l.spec.fn, l.wd, l.hyper) for l in lanes)
+        dp = NamedSharding(mesh, PartitionSpec("proc")) \
+            if zero1 else None
+        rep = NamedSharding(mesh, PartitionSpec()) \
+            if nproc > 1 else None
+
+        def program(w_flats, g_flats, state_flats, lrs, ts):
+            if nproc > 1:
+                # the gradient exchange: the same stacked sum the
+                # bucketed kvstore allreduce jits, fused in-program so
+                # XLA schedules it behind the update math
+                g_flats = tuple(jnp.sum(g, axis=0) for g in g_flats)
+            if guard:
+                ok = jnp.all(jnp.stack(
+                    [jnp.isfinite(g).all() for g in g_flats]))
+            else:
+                ok = jnp.bool_(True)
+
+            def apply():
+                outs_w, outs_s = [], []
+                for (fn, wd, hyper), w, g, st, lr, t in zip(
+                        statics, w_flats, g_flats, state_flats,
+                        lrs, ts):
+                    if dp is not None:
+                        # ZeRO-1: constrain grads + state to the dp
+                        # axis so the partitioner lowers the exchange
+                        # as reduce-scatter, runs the update on the
+                        # local 1/N shard, and all-gathers the params
+                        g = lax.with_sharding_constraint(g, dp)
+                        st = tuple(
+                            lax.with_sharding_constraint(s, dp)
+                            for s in st)
+                    nw, ns = fn(w, g, st, lr, t, wd, hyper)
+                    if rep is not None:
+                        nw = lax.with_sharding_constraint(nw, rep)
+                    outs_w.append(nw)
+                    outs_s.append(tuple(ns))
+                return tuple(outs_w), tuple(outs_s)
+
+            if guard:
+                # ONE lax.cond over the WHOLE step body (the PR-9
+                # contract): the false branch passes every weight and
+                # state flat through bit-identically
+                new_w, new_s = lax.cond(
+                    ok, apply,
+                    lambda: (tuple(w_flats),
+                             tuple(tuple(s) for s in state_flats)))
+            else:
+                new_w, new_s = apply()
+            return new_w, new_s, ok
+
+        kw = {"donate_argnums": (0, 2) if donate else ()}
+        if nproc > 1:
+            state_out = tuple(
+                tuple((dp if zero1 else rep) for _ in lane_states)
+                for lane_states in packed[2])
+            kw["out_shardings"] = (tuple(rep for _ in lanes),
+                                   state_out, rep)
+        jitted = jax.jit(program, **kw)
+        fn = self._aot_or_jit(sig, jitted, packed, nproc, zero1,
+                              guard, donate, lanes)
+        if len(self._programs) > 64:
+            # membership/cohort churn: same bound as the layout-plan
+            # and refusal caches — steady-state training holds one
+            self._programs.clear()
+            self._aot.clear()
+        self._programs[sig] = fn
+        return fn
+
+    def _aot_or_jit(self, sig, jitted, packed, nproc, zero1, guard,
+                    donate, lanes):
+        """Try the PR-11 artifact store for this program signature;
+        fall back to (and optionally export from) the jit.
+        Multi-process (process-spanning mesh) programs never touch the
+        store — a deserialized multi-device CPU executable can
+        segfault jaxlib (compile/cache.py guard); the single-device
+        flat programs here are the same class as the fused-update
+        kernels, which round-trip safely."""
+        store = _aot.default_store()
+        if store is None or nproc > 1:
+            return jitted
+        extra = {
+            "kind": "fused_step",
+            "lanes": [[l.spec.name, repr(l.bucket.signature),
+                       l.wd, [repr(h) for h in l.hyper]]
+                      for l in lanes],
+            # the stable bucket-layout plan signature: a layout change
+            # re-fingerprints -> counted fallback, never a stale load
+            "plan": self._updater._layout.plan_signature(
+                [l.bucket for l in lanes]),
+            "zero1": zero1, "guard": guard, "donate": donate,
+            "args": _aot.aval_signature(packed),
+        }
+        name = "fused_step/%s" % _aot.fingerprint(extra)[:16]
+        loaded = store.load_jit(name, extra)
+        if loaded is None and _aot.export_enabled():
+            try:
+                avals = _aot.abstract(packed)
+                compiled = _aot.compile_fresh(jitted, avals)
+                store.put(name, _aot.fingerprint(extra), compiled)
+                loaded = compiled
+            except Exception:   # noqa: BLE001 — capture is best-effort
+                loaded = None
+        if loaded is None:
+            return jitted
+        self._aot[sig] = loaded
+
+        def call(*args):
+            try:
+                return loaded(*args)
+            except (TypeError, ValueError):
+                # aval refusal happens BEFORE execution, so the donated
+                # flats are intact: latch this signature to JIT for
+                # good and count the fallback
+                self._aot[sig] = False
+                self._programs[sig] = jitted
+                _aot.FALLBACKS.inc(reason="dispatch")
+                return jitted(*args)
+        return call
+
+    # -- unpacking -------------------------------------------------------
+    def _unpack(self, lanes, new_w, new_states, sig, nproc, zero1):
+        from .bucketing import UNPACK_SECONDS
+        t0 = time.perf_counter()
+        lanes_meta, kept = [], []
+        for lane, w_flat, state in zip(lanes, new_w, new_states):
+            b, group = lane.bucket, lane.group
+            if nproc > 1:
+                w_flat = jnp.asarray(w_flat.addressable_data(0))
+            # post-update corruption site (the SDC simulation), same
+            # as the staged path's
+            w_flat = corrupt_point("weight.post", w_flat)
+            for e, w_sub in zip(group, b.unpack(w_flat)):
+                if e.master is not None:
+                    e.master._data = w_sub
+                    e.weight._data = w_sub.astype(e.weight._data.dtype)
+                else:
+                    e.weight._data = w_sub
+            if zero1:
+                # sharded state flats are the authoritative copy,
+                # carried (donated) into the next step; the per-key
+                # NDArrays re-materialize at the flush boundary
+                lanes_meta.append((b, [e.state_leaves for e in group],
+                                   b.sizes))
+                kept.append(tuple(state))
+            else:
+                for s in range(lane.n_states):
+                    flat = state[s]
+                    if nproc > 1:
+                        flat = jnp.asarray(flat.addressable_data(0))
+                    for e, s_sub in zip(group, b.unpack(flat)):
+                        e.state_leaves[s]._data = s_sub
+        if zero1:
+            self._state_flats = {sig: (lanes_meta, kept)}
+        UNPACK_SECONDS.observe(time.perf_counter() - t0)
+
+
+def _exchange_plan(kvstore):
+    """(nproc, mesh) for the in-program gradient exchange, or
+    (None, None) when the kvstore's semantics cannot be fused (a
+    compressing store, an exotic type)."""
+    if kvstore is None:
+        return 1, None
+    if getattr(kvstore, "_compression", None) is not None:
+        return None, None     # compressed exchange: staged path
+    from .kvstore_dist import DistKVStore
+    if isinstance(kvstore, DistKVStore):
+        if kvstore.num_workers <= 1:
+            return 1, None
+        return kvstore.num_workers, kvstore._proc_mesh()
+    # local/device stores: the single-worker reduce is an identity
+    # round-trip — safe to subsume
+    if getattr(kvstore, "num_workers", 1) <= 1:
+        return 1, None
+    return None, None
+
+
+def eligible(updater, indices, kvstore=None):
+    """Cheap, side-effect-free pre-check for the fused step: the
+    latched/static refusals (env gate, updater type, optimizer class,
+    exchange topology, a previously refused key set). Callers use it
+    to avoid opening telemetry phases / trace spans for runs that are
+    permanently staged; `run()` still re-checks everything."""
+    if not enabled():
+        return False
+    from .fused_update import FusedUpdater, _SUPPORTED
+    if not isinstance(updater, FusedUpdater):
+        return False
+    o = updater.optimizer
+    if _SUPPORTED.get(type(o)) is None or type(o) not in _STEP_OPTS:
+        return False
+    step = getattr(updater, "_fused_step_owner", None)
+    if step is not None and (type(o), tuple(indices)) in step._refused:
+        return False
+    return _exchange_plan(kvstore)[0] is not None
+
+
+def try_step(updater, indices, grads, weights, kvstore=None):
+    """Module/Trainer entry: run the fused one-program step when the
+    updater supports it. Returns True when it ran."""
+    step = getattr(updater, "_fused_step_owner", None)
+    if step is None:
+        try:
+            step = FusedTrainStep(updater)
+        except TypeError:
+            return False
+    return step.run(indices, grads, weights, kvstore=kvstore)

@@ -225,24 +225,28 @@ _AOT = {}    # signature -> loaded executable, or False (known miss)
 
 
 def _aot_sig(spec, donate, guarded, w_flat, g_flat, state_flats, wd,
-             hyper):
+             hyper, layout=None):
     return (spec.name, bool(donate), bool(guarded),
             tuple(w_flat.shape), str(w_flat.dtype), str(g_flat.dtype),
             tuple((tuple(s.shape), str(s.dtype)) for s in state_flats),
-            wd, hyper)
+            wd, hyper, layout)
 
 
 def _aot_kernel(spec, donate, guarded, w_flat, g_flat, state_flats,
-                wd, hyper):
+                wd, hyper, layout=None):
     """The AOT executable for one group signature, or None (JIT path).
     lr/t stay traced inputs (they change per step); wd/hyper are baked
     into the exported closure exactly as static_argnums bakes them into
-    the jit program, and both ride the fingerprint."""
+    the jit program, and both ride the fingerprint — as does `layout`,
+    the stable bucket plan signature (GradBucketer.plan_signature):
+    flat shapes alone cannot distinguish two orderings of the same
+    keys, so a layout change must miss the store (a counted fallback),
+    never load a same-shaped program built for another layout."""
     store = _aot.default_store()
     if store is None:
         return None
     sig = _aot_sig(spec, donate, guarded, w_flat, g_flat, state_flats,
-                   wd, hyper)
+                   wd, hyper, layout)
     cached = _AOT.get(sig)
     if cached is not None:
         return cached or None
@@ -254,7 +258,7 @@ def _aot_kernel(spec, donate, guarded, w_flat, g_flat, state_flats,
              jax.ShapeDtypeStruct((), jnp.int32))
     extra = {"kind": "fused_update", "spec": spec.name,
              "donate": bool(donate), "guarded": bool(guarded),
-             "wd": wd, "hyper": hyper,
+             "wd": wd, "hyper": hyper, "layout": layout,
              "args": _aot.aval_signature(avals)}
     name = "fused/%s/%s" % (spec.name, _aot.fingerprint(extra)[:16])
     fn = store.load_jit(name, extra)
@@ -322,13 +326,24 @@ class FusedUpdater(opt.Updater):
         # buffer per (dtype, lane); plans memoized on the item tuple so
         # steady-state steps pay one dict lookup
         self._layout = GradBucketer(target_bytes=_NO_LIMIT)
+        # set by an attached parallel.fused_step.FusedTrainStep: its
+        # ZeRO-1-sharded state flats must flush back into self.states
+        # before any per-key read/write (get_states, staged fallback)
+        self._fused_step_owner = None
+
+    def _flush_fused_step(self):
+        if self._fused_step_owner is not None:
+            self._fused_step_owner.flush_state()
 
     # -- eligibility ----------------------------------------------------
-    def _collect(self, spec, indices, grads, weights):
+    def _collect(self, spec, indices, grads, weights, require_all=False):
         """Resolve counts/lr/wd and split (fused entries, per-key
         leftovers), preserving caller order inside each split. Count
-        bookkeeping for fused entries happens here, in caller order —
-        exactly where the per-key path would do it."""
+        bookkeeping for fused entries happens in caller order — exactly
+        where the per-key path would do it — but only AFTER the whole
+        set validated, so `require_all=True` (the fused-step probe) can
+        refuse a set with leftovers as `(None, leftovers)` without
+        having bumped a single update count."""
         o = self.optimizer
         entries, leftovers = [], []
         for i, g, w in zip(indices, grads, weights):
@@ -379,7 +394,6 @@ class FusedUpdater(opt.Updater):
             if leaves is None:
                 leftovers.append((i, g, w))
                 continue
-            o._update_count(i)
             # lane: the stable group identity — raw weight dtype rides
             # along so mp groups never mix fp16 and bf16 grads in one
             # packed buffer (the flat itself is master-fp32 for mp)
@@ -387,8 +401,17 @@ class FusedUpdater(opt.Updater):
                     o._resolved_mult(i, "lr_mult"),
                     o._resolved_mult(i, "wd_mult"))
             entries.append(_Entry(i, w, pack_w, g_arr, leaves, master,
-                                  o._get_lr(i), o._get_wd(i),
-                                  o._index_update_count[i], lane))
+                                  None, None, None, lane))
+        if require_all and leftovers:
+            return None, leftovers
+        # phase 2: counts + lr/wd resolution in caller order, each
+        # entry reading the scheduler state its predecessors advanced —
+        # identical interleaving to the per-key path
+        for e in entries:
+            o._update_count(e.index)
+            e.lr = o._get_lr(e.index)
+            e.wd = o._get_wd(e.index)
+            e.t = o._index_update_count[e.index]
         return entries, leftovers
 
     # -- the fused step -------------------------------------------------
@@ -396,6 +419,9 @@ class FusedUpdater(opt.Updater):
         """Apply the optimizer to the whole (index, grad, weight) set:
         a few donated jit calls for the fused groups, the inherited
         per-key path for everything else — bit-identical either way."""
+        # a ZeRO-1 fused-step owner may hold the authoritative state
+        # as sharded flats: re-materialize before any per-key use
+        self._flush_fused_step()
         spec = _SUPPORTED.get(type(self.optimizer))
         if spec is None or not fused_enabled() or len(indices) < 2:
             super().update_all(indices, grads, weights)
@@ -410,16 +436,26 @@ class FusedUpdater(opt.Updater):
         # they must NOT be rerouted through per-key __call__ (update()
         # would bump the count again). A 1-entry group still runs the
         # fused kernel — same math, one dispatch.
-        #
-        # cohort key is (t, lr, wd), not just t: with an lr_scheduler
-        # and skewed update counts, two same-t entries can resolve
-        # DIFFERENT lr values mid-collection (the scheduler reads the
-        # global num_update another entry just bumped) — the per-key
-        # path would honor each, so the fused groups must too
+        donate = donate_enabled()
+        for bucket, group, t, _lr, _wd in self._plan_cohorts(entries):
+            self._run_group(spec, bucket, group, t, donate)
+        for i, g, w in leftovers:
+            self(i, g, w)
+
+    def _plan_cohorts(self, entries):
+        """Yield (bucket, group, t, lr, wd) for the whole entry set —
+        THE cohort/layout planning both the staged per-group dispatch
+        and the fused one-program step (parallel/fused_step.py) share,
+        so their flats stay byte-identical by construction.
+
+        Cohort key is (t, lr, wd), not just t: with an lr_scheduler
+        and skewed update counts, two same-t entries can resolve
+        DIFFERENT lr values mid-collection (the scheduler reads the
+        global num_update another entry just bumped) — the per-key
+        path would honor each, so the planned groups must too."""
         by_cohort = {}
         for pos, e in enumerate(entries):
             by_cohort.setdefault((e.t, e.lr, e.wd), []).append((pos, e))
-        donate = donate_enabled()
         if len(self._layout._plans) > 64:
             # membership churn (a trainable subset that varies per
             # step) would grow the memoized layouts without bound;
@@ -428,18 +464,30 @@ class FusedUpdater(opt.Updater):
             # per-step subsets should run MXTPU_FUSED_UPDATE=0
             # (docs/performance.md).
             self._layout.clear()
-        for (t, _lr, _wd), cohort in sorted(by_cohort.items()):
+        for (t, lr, wd), cohort in sorted(by_cohort.items()):
             items = tuple(
                 (e.index, tuple(e.pack_w.shape), str(e.pack_w.dtype),
                  -pos, e.lane)
                 for pos, e in cohort)
             by_index = {e.index: e for _, e in cohort}
             for bucket in self._layout.plan(items):
-                self._run_group(spec, bucket,
-                                [by_index[k] for k in bucket.keys],
-                                t, donate)
-        for i, g, w in leftovers:
-            self(i, g, w)
+                yield (bucket, [by_index[k] for k in bucket.keys],
+                       t, lr, wd)
+
+    def __call__(self, index, grad, weight):
+        self._flush_fused_step()
+        super().__call__(index, grad, weight)
+
+    def get_states(self, dump_optimizer=False):
+        self._flush_fused_step()
+        return super().get_states(dump_optimizer=dump_optimizer)
+
+    def set_states(self, states):
+        if self._fused_step_owner is not None:
+            # the pickled states are about to become authoritative:
+            # drop (don't flush) any carried sharded flats
+            self._fused_step_owner.drop_state()
+        super().set_states(states)
 
     def _run_group(self, spec, bucket, group, t, donate):
         o = self.optimizer
@@ -465,8 +513,12 @@ class FusedUpdater(opt.Updater):
         guarded = _num.enabled()
         out = None
         hyper = spec.hyper(o)
+        # layout fingerprint only when a store is configured: the
+        # repr+sha256 walk is wasted work on the storeless hot path
+        layout = self._layout.plan_signature([bucket]) \
+            if _aot.default_store() is not None else None
         aot_fn = _aot_kernel(spec, donate, guarded, w_flat, g_flat,
-                             state_flats, wd, hyper)
+                             state_flats, wd, hyper, layout)
         if aot_fn is not None:
             try:
                 out = aot_fn(w_flat, g_flat, state_flats,
@@ -479,14 +531,14 @@ class FusedUpdater(opt.Updater):
                 # sig is rebuilt HERE, not on the hot path — failure
                 # is the rare case
                 _AOT[_aot_sig(spec, donate, guarded, w_flat, g_flat,
-                              state_flats, wd, hyper)] = False
+                              state_flats, wd, hyper, layout)] = False
                 _aot.FALLBACKS.inc(reason="dispatch")
             except Exception:
                 # a failure DURING execution may have consumed the
                 # donated weight/state flats — re-dispatching them
                 # would corrupt the update; latch and surface
                 _AOT[_aot_sig(spec, donate, guarded, w_flat, g_flat,
-                              state_flats, wd, hyper)] = False
+                              state_flats, wd, hyper, layout)] = False
                 _aot.FALLBACKS.inc(reason="dispatch")
                 raise
         if out is None:
@@ -505,6 +557,8 @@ class FusedUpdater(opt.Updater):
         new_w = corrupt_point("weight.post", new_w)
         FUSED_GROUPS.inc()
         _UPDATE_DISPATCHES.inc()
+        from .fused_step import STEP_DISPATCHES
+        STEP_DISPATCHES.inc()   # staged path: one dispatch per group
         FUSED_UPDATE_SECONDS.observe(time.perf_counter() - t0)
         for e, w_sub in zip(group, bucket.unpack(new_w)):
             if e.master is not None:

@@ -49,6 +49,9 @@ _AR_BYTES = _obs.counter("kvstore.allreduce.bytes",
                          "Local bytes contributed to cross-process "
                          "allreduce/allgather collectives")
 _AR_CALLS = _obs.counter("kvstore.allreduce.calls")
+# every exchange collective is one device program toward the step's
+# dispatch budget (registered+documented in parallel/fused_step.py)
+_STEP_DISPATCHES = _obs.counter("train.step.dispatches")
 _AR_SECONDS = _obs.histogram("kvstore.allreduce.seconds",
                              "Wall time of one cross-process collective")
 
@@ -405,6 +408,7 @@ class DistKVStore(KVStore):
         self.last_wire_bytes = int(packed.size) * 4
         _AR_BYTES.inc(self.last_wire_bytes)
         _AR_CALLS.inc()
+        _STEP_DISPATCHES.inc()
         sharding = NamedSharding(mesh, PartitionSpec("proc"))
         mine = [d for d in mesh.devices.flat
                 if d.process_index == jax.process_index()]
@@ -449,6 +453,7 @@ class DistKVStore(KVStore):
         t0 = time.perf_counter()
         _AR_BYTES.inc(int(x.size) * x.dtype.itemsize)
         _AR_CALLS.inc()
+        _STEP_DISPATCHES.inc()
         # global array (nproc, *x.shape) sharded over 'proc': this
         # process contributes x on its mesh device
         sharding = NamedSharding(mesh, PartitionSpec("proc"))
@@ -478,6 +483,7 @@ class DistKVStore(KVStore):
         self.last_wire_bytes = int(packed.size) * 4  # diagnostics/tests
         _AR_BYTES.inc(self.last_wire_bytes)
         _AR_CALLS.inc()
+        _STEP_DISPATCHES.inc()
         sharding = NamedSharding(mesh, PartitionSpec("proc"))
         mine = [d for d in mesh.devices.flat
                 if d.process_index == jax.process_index()]
@@ -535,6 +541,7 @@ class DistKVStore(KVStore):
         self.last_wire_bytes = int(idx.size) * 4 + int(val.size) * 4
         _AR_BYTES.inc(self.last_wire_bytes)
         _AR_CALLS.inc()
+        _STEP_DISPATCHES.inc()
         sharding_i = NamedSharding(mesh, PartitionSpec("proc"))
         mine = [d for d in mesh.devices.flat
                 if d.process_index == jax.process_index()][0]

@@ -148,12 +148,18 @@ _unguarded = [0]     # updates applied WITHOUT the in-graph guard since
 
 # flag provenance -> what a bad verdict MEANS:
 #   "update"   fused-update group skipped in-graph (state preserved)
-#   "step"     whole compiled ShardedTrainer step skipped (preserved)
+#   "step"     a WHOLE compiled step skipped with state preserved —
+#              the ShardedTrainer one-program step, or the fused
+#              exchange+update program behind gluon.Trainer /
+#              Module.update (parallel/fused_step.py): one lax.cond
+#              over the entire step body, one verdict per step
 #   "exchange" allreduce bucket carried non-finite values (attribution
 #              only — whether the apply was skipped is the update
 #              flag's business)
 #   "window"   a step_many window went bad (detection-only: the scan
-#              body is unguarded, the weights WERE poisoned)
+#              body is unguarded, the weights WERE poisoned — the
+#              guard is NEVER applied inside a lax.scan; see
+#              data_parallel._make_step_body)
 _PROTECTED = ("update", "step")
 
 

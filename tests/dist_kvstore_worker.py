@@ -217,6 +217,125 @@ def main():
                 (rnd, key)
     print("COMPRESSED_BUCKET_PARITY_OK_%d" % rank)
 
+    # ---- fused one-program step + ZeRO-1 over gloo ------------------
+    # (ISSUE 15 acceptance): the same model/data trained three ways —
+    # fused step with ZeRO-1-sharded optimizer state, fused step with
+    # replicated state, and the staged bucketed path — must produce
+    # bit-identical parameters on every rank, and the sharded run's
+    # state must all-gather back bit-identically at save_states.
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.observability import registry as obs
+
+    def _train(fused, zero1, tag):
+        os.environ["MXTPU_FUSED_STEP"] = "1" if fused else "0"
+        os.environ["MXTPU_ZERO1"] = "1" if zero1 else "0"
+        mx.random.seed(7)
+        net = gluon.nn.Dense(5, prefix="z1%s_" % tag)
+        net.initialize()
+        x0 = mx.nd.array(np.random.RandomState(1).randn(2, 9)
+                         .astype("f"))
+        net(x0)
+        kvt = mx.kv.create("dist_sync")
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=kvt)
+        loss_fn = gluon.loss.L2Loss()
+        for s in range(3):
+            r = np.random.RandomState(1000 + 10 * s + rank)
+            x = mx.nd.array(r.randn(2, 9).astype("f"))
+            y = mx.nd.array(r.randn(2, 5).astype("f"))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(2 * nw)
+        params = [p.data().asnumpy()
+                  for p in net.collect_params().values()]
+        states = tr._updaters[0].get_states()
+        return params, states
+
+    disp = obs.REGISTRY.counter("train.step.dispatches")
+    d0 = disp.total()
+    pz, sz = _train(True, True, "a")
+    zero1_dispatches = disp.total() - d0
+    d0 = disp.total()
+    pr, sr = _train(True, False, "b")
+    fused_dispatches = disp.total() - d0
+    ps, ss = _train(False, False, "c")
+    os.environ["MXTPU_ZERO1"] = "0"
+    for a, b, c in zip(pz, pr, ps):
+        assert a.tobytes() == b.tobytes(), "zero1 vs replicated drift"
+        assert b.tobytes() == c.tobytes(), "fused vs staged drift"
+    # sharded momentum all-gathered at get_states == replicated run's
+    assert sz == sr == ss, "optimizer state drift across paths"
+
+    # mid-run MXTPU_ZERO1 toggle: the carried sharded state must flush
+    # at the knob boundary (full-signature keyed), never feed a
+    # replicated program — and numerics stay bit-exact
+    def _train_toggle(tag):
+        os.environ["MXTPU_FUSED_STEP"] = "1"
+        mx.random.seed(7)
+        net = gluon.nn.Dense(5, prefix="z1%s_" % tag)
+        net.initialize()
+        net(mx.nd.array(np.random.RandomState(1).randn(2, 9)
+                        .astype("f")))
+        kvt = mx.kv.create("dist_sync")
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=kvt)
+        loss_fn = gluon.loss.L2Loss()
+        for s in range(4):
+            os.environ["MXTPU_ZERO1"] = "1" if s < 2 else "0"
+            r = np.random.RandomState(1000 + 10 * s + rank)
+            x = mx.nd.array(r.randn(2, 9).astype("f"))
+            y = mx.nd.array(r.randn(2, 5).astype("f"))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(2 * nw)
+        return ([p.data().asnumpy()
+                 for p in net.collect_params().values()],
+                tr._updaters[0].get_states())
+    pt, st_t = _train_toggle("d")
+    os.environ["MXTPU_ZERO1"] = "0"
+    # 4 toggle steps == first 3 replicated steps + one more would need
+    # a 4th reference step; instead compare against a fresh 4-step
+    # replicated run
+    def _train4(tag):
+        os.environ["MXTPU_FUSED_STEP"] = "1"
+        mx.random.seed(7)
+        net = gluon.nn.Dense(5, prefix="z1%s_" % tag)
+        net.initialize()
+        net(mx.nd.array(np.random.RandomState(1).randn(2, 9)
+                        .astype("f")))
+        kvt = mx.kv.create("dist_sync")
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=kvt)
+        loss_fn = gluon.loss.L2Loss()
+        for s in range(4):
+            r = np.random.RandomState(1000 + 10 * s + rank)
+            x = mx.nd.array(r.randn(2, 9).astype("f"))
+            y = mx.nd.array(r.randn(2, 5).astype("f"))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(2 * nw)
+        return ([p.data().asnumpy()
+                 for p in net.collect_params().values()],
+                tr._updaters[0].get_states())
+    p4, s4 = _train4("e")
+    for a, b in zip(pt, p4):
+        assert a.tobytes() == b.tobytes(), "zero1 toggle drift"
+    assert st_t == s4, "zero1 toggle state drift"
+    print("ZERO1_TOGGLE_OK_%d" % rank)
+    # the fused runs issued exactly ONE device program per step
+    assert zero1_dispatches == 3, zero1_dispatches
+    assert fused_dispatches == 3, fused_dispatches
+    # the ZeRO-1 state gather was a real observed all-gather
+    ag = obs.REGISTRY.get("zero1.allgather.seconds")
+    assert ag is not None and ag.total_count() > 0
+    print("ZERO1_PARITY_OK_%d" % rank)
+
     kv.barrier()
     print("WORKER_%d_OK" % rank)
 

@@ -492,10 +492,19 @@ class TestFusedUpdateAOT:
         monkeypatch.setenv("MXTPU_AOT_EXPORT", "1")
         fused_update._AOT.clear()
         try:
+            # fused-step era (ISSUE 15): the Trainer loop dispatches
+            # ONE exchange+update program per step, so the capture
+            # harvests a fused_step/ executable; the staged kernels
+            # are captured under the MXTPU_FUSED_STEP=0 escape hatch
             captured = self._train()              # capture pass
             store = ArtifactStore(tmp_path)
+            assert any(n.startswith("fused_step/")
+                       for n in store.entries())
+            monkeypatch.setenv("MXTPU_FUSED_STEP", "0")
+            staged = self._train()                # staged capture pass
             assert any(n.startswith("fused/adam/")
                        for n in store.entries())
+            monkeypatch.delenv("MXTPU_FUSED_STEP")
             fused_update._AOT.clear()             # force a re-load
             monkeypatch.setenv("MXTPU_AOT_EXPORT", "0")
             loads_before = _total("compile.aot.loads")
@@ -509,10 +518,12 @@ class TestFusedUpdateAOT:
         def by_suffix(d):
             return {k.rsplit("_", 1)[1]: v for k, v in d.items()}
 
-        ref, captured, replayed = (by_suffix(ref), by_suffix(captured),
-                                   by_suffix(replayed))
+        ref, captured, staged, replayed = (
+            by_suffix(ref), by_suffix(captured), by_suffix(staged),
+            by_suffix(replayed))
         for k in ref:
             assert np.array_equal(ref[k], captured[k]), k
+            assert np.array_equal(ref[k], staged[k]), k
             assert np.array_equal(ref[k], replayed[k]), k
 
 

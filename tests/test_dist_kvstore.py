@@ -49,3 +49,8 @@ def test_dist_sync_kvstore_four_processes():
         # (asserted inside the worker; the markers prove it ran)
         assert ("BUCKET_PARITY_OK_%d" % r) in out
         assert ("COMPRESSED_BUCKET_PARITY_OK_%d" % r) in out
+        # fused one-program step: ZeRO-1-sharded == replicated ==
+        # staged, one dispatch per step, state all-gather bit-exact
+        # (asserted inside the worker; the marker proves it ran)
+        assert ("ZERO1_PARITY_OK_%d" % r) in out
+        assert ("ZERO1_TOGGLE_OK_%d" % r) in out

@@ -1,0 +1,469 @@
+"""One compiled program per training step + ZeRO-1
+(parallel/fused_step.py; docs/performance.md "Fused train step &
+ZeRO-1").
+
+The contract under test:
+
+1. bit parity: the fused one-program step behind gluon.Trainer /
+   Module update produces byte-identical weights AND optimizer state
+   vs the staged bucketed path (exchange then update) — SGD, momentum,
+   Adam, fp16-under-fp32-master multi-precision — with the
+   MXTPU_FUSED_STEP=0 and MXTPU_ZERO1=0 escape hatches exercised both
+   ways;
+2. dispatch count: the fused path issues exactly ONE device program
+   per step (train.step.dispatches metric + program-cache census),
+   the staged path O(buckets)+O(groups);
+3. numerics-guard composition: chaos kind=nan at grad.post inside the
+   fused step skips in-graph with weights/opt state preserved
+   bit-identically, and the verdict reaches the watchdog/telemetry
+   exactly once;
+4. ZeRO-1 checkpoint round-trip: dp-sharded optimizer state saves
+   through TrainerCheckpoint two-phase commit and restores
+   bit-identically into sharded AND replicated topologies of a
+   different replica count;
+5. plan signatures: bucket-layout changes re-fingerprint AOT programs.
+
+Multi-process (gloo, 4 ranks) ZeRO-1 == replicated == staged parity is
+asserted in tests/dist_kvstore_worker.py (ZERO1_PARITY_OK markers).
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.observability import registry as obs
+from mxnet_tpu.parallel import fused_step as fs
+from mxnet_tpu.resilience import chaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def step_env(monkeypatch):
+    def set_fused(on, zero1=False):
+        monkeypatch.setenv("MXTPU_FUSED_STEP", "1" if on else "0")
+        monkeypatch.setenv("MXTPU_ZERO1", "1" if zero1 else "0")
+    yield set_fused
+
+
+def _train_gluon(optname, optkw, steps=4, dtype="float32", seed=0):
+    """A tiny gluon loop: returns (param arrays, pickled updater
+    states) after `steps` autograd+Trainer.step iterations."""
+    mx.random.seed(seed)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    x0 = mx.nd.array(np.random.RandomState(1).randn(4, 5).astype("f"))
+    net(x0)
+    if dtype != "float32":
+        net.cast(dtype)
+        net(mx.nd.array(np.random.RandomState(1).randn(4, 5)
+                        .astype(dtype)))
+    tr = gluon.Trainer(net.collect_params(), optname, dict(optkw))
+    loss_fn = gluon.loss.L2Loss()
+    for s in range(steps):
+        x = mx.nd.array(np.random.RandomState(10 + s).randn(4, 5)
+                        .astype(dtype))
+        y = mx.nd.array(np.random.RandomState(20 + s).randn(4, 3)
+                        .astype(dtype))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(4)
+    params = [p.data().asnumpy() for p in net.collect_params().values()]
+    states = pickle.loads(tr._updaters[0].get_states())
+    return params, states, tr
+
+
+def _state_bytes(states):
+    out = []
+    for k in sorted(states):
+        st = states[k]
+        stack = [st]
+        while stack:
+            s = stack.pop()
+            if s is None:
+                continue
+            if isinstance(s, (list, tuple)):
+                stack.extend(s)
+            else:
+                out.append(np.asarray(s.asnumpy()).tobytes())
+    return out
+
+
+@pytest.mark.parametrize("name,kw,dtype", [
+    ("sgd", dict(learning_rate=0.1), "float32"),
+    ("sgd", dict(learning_rate=0.1, momentum=0.9, wd=0.01), "float32"),
+    ("adam", dict(learning_rate=0.01, wd=0.001), "float32"),
+    ("sgd", dict(learning_rate=0.1, momentum=0.9,
+                 multi_precision=True), "float16"),
+    ("adam", dict(learning_rate=0.01,
+                  multi_precision=True), "float16"),
+])
+def test_fused_step_bit_parity(name, kw, dtype, step_env):
+    step_env(True)
+    a_p, a_s, _ = _train_gluon(name, kw, dtype=dtype)
+    step_env(False)
+    b_p, b_s, _ = _train_gluon(name, kw, dtype=dtype)
+    for a, b in zip(a_p, b_p):
+        assert a.dtype == b.dtype
+        assert a.tobytes() == b.tobytes()
+    assert _state_bytes(a_s) == _state_bytes(b_s)
+
+
+def test_fused_step_one_dispatch_per_step(step_env):
+    disp = obs.REGISTRY.counter("train.step.dispatches")
+    step_env(True)
+    d0 = disp.total()
+    _, _, tr = _train_gluon("sgd", dict(learning_rate=0.1,
+                                        momentum=0.9), steps=5)
+    assert disp.total() - d0 == 5          # exactly ONE program/step
+    # jit-cache census: steady-state training holds exactly one
+    # compiled step program (the PR-6 two-program-assert analog)
+    owner = tr._updaters[0]._fused_step_owner
+    assert owner is not None and owner.program_count() == 1
+    # staged path: O(groups) per step (two lanes here: weight wd_mult
+    # lane + bias lane collapse into one fp32 bucket per cohort)
+    step_env(False)
+    d0 = disp.total()
+    _train_gluon("sgd", dict(learning_rate=0.1, momentum=0.9), steps=5)
+    staged = disp.total() - d0
+    assert staged >= 5                     # at least one per step
+
+
+def test_fused_step_telemetry_record_and_phase(step_env, tmp_path,
+                                               monkeypatch):
+    tel = tmp_path / "t.jsonl"
+    monkeypatch.setenv("MXTPU_TELEMETRY", str(tel))
+    step_env(True)
+    _train_gluon("sgd", dict(learning_rate=0.1), steps=3)
+    from mxnet_tpu.observability.telemetry import close_stream
+    close_stream()
+    recs = [json.loads(line) for line in tel.read_text().splitlines()]
+    steps = [r for r in recs if r.get("source") == "gluon.trainer"]
+    assert steps
+    # one "step" phase, no host allreduce/optimizer phases, and the
+    # dispatch budget field reads 1 (acceptance: the host-side Python
+    # between phases is gone from the trace)
+    for r in steps[1:]:
+        assert r.get("step_dispatches") == 1
+        assert "step_time" in r
+        assert "allreduce_time" not in r and "optimizer_time" not in r
+
+
+def test_perf_gate_dispatch_budget(step_env, tmp_path, monkeypatch):
+    tel = tmp_path / "t.jsonl"
+    monkeypatch.setenv("MXTPU_TELEMETRY", str(tel))
+    step_env(True)
+    _train_gluon("adam", dict(learning_rate=0.01), steps=3)
+    from mxnet_tpu.observability.telemetry import close_stream
+    close_stream()
+    gate = os.path.join(ROOT, "tools", "perf_gate.py")
+    r = subprocess.run([sys.executable, gate, str(tel),
+                        "--max-dispatches-per-step", "1"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # tighter than 1 program/step is unachievable: breach
+    r = subprocess.run([sys.executable, gate, str(tel),
+                        "--max-dispatches-per-step", "0.5"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "dispatches_per_step" in r.stdout
+    # a stream without the metric must breach, not pass silently
+    legacy = tmp_path / "legacy.jsonl"
+    legacy.write_text(json.dumps(
+        {"ts": 0, "source": "train", "step": 0, "step_time": 0.1}) +
+        "\n")
+    r = subprocess.run([sys.executable, gate, str(legacy),
+                        "--max-dispatches-per-step", "1"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+
+
+def test_guard_composition_chaos_nan(step_env):
+    """kind=nan at grad.post INSIDE the fused step: the lax.cond skip
+    preserves weights + opt state bit-identically and the verdict
+    reaches the watchdog/telemetry exactly once."""
+    step_env(True)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(1).randn(4, 5).astype("f"))
+    y = mx.nd.array(np.random.RandomState(2).randn(4, 3).astype("f"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+
+    def one_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(4)
+
+    one_step()                      # clean step: program + state exist
+    pre_w = [p.data().asnumpy().copy()
+             for p in net.collect_params().values()]
+    pre_s = tr._updaters[0].get_states()
+    anom0 = obs.REGISTRY.get("numerics.anomalies").total()
+    skip0 = obs.REGISTRY.get("numerics.skipped_steps").total()
+    bad0 = tr.numerics.watchdog.bad_streak
+    chaos.configure("grad.post:kind=nan,n=1", seed=7)
+    try:
+        one_step()
+    finally:
+        chaos.reset()
+    for a, b in zip(pre_w, [p.data().asnumpy()
+                            for p in net.collect_params().values()]):
+        assert a.tobytes() == b.tobytes()
+    assert pre_s == tr._updaters[0].get_states()
+    rep = tr.numerics.last_report
+    assert rep["skipped_steps"] == 1 and rep["anomalies"] == 1
+    # exactly once: metric deltas of 1, watchdog streak advanced by 1
+    assert obs.REGISTRY.get("numerics.anomalies").total() - anom0 == 1
+    assert (obs.REGISTRY.get("numerics.skipped_steps").total()
+            - skip0 == 1)
+    assert tr.numerics.watchdog.bad_streak == bad0 + 1
+    one_step()                      # clean step: streak resets
+    assert tr.numerics.last_report["anomalies"] == 0
+    assert tr.numerics.watchdog.bad_streak == 0
+
+
+def test_escape_hatch_mid_run(step_env):
+    """Toggling MXTPU_FUSED_STEP mid-run keeps training exact: the
+    fused and staged paths share updater state."""
+    step_env(True)
+    mx.random.seed(3)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(5).randn(4, 6).astype("f"))
+    y = mx.nd.array(np.random.RandomState(6).randn(4, 4).astype("f"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+
+    def steps(n):
+        for _ in range(n):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(4)
+
+    steps(2)
+    step_env(False)
+    steps(2)
+    step_env(True)
+    steps(2)
+    mixed = [p.data().asnumpy() for p in net.collect_params().values()]
+    step_env(False)
+    b_p, _, _ = _train_gluon_fixed_dense(net_seed=3, steps=6)
+    for a, b in zip(mixed, b_p):
+        assert a.tobytes() == b.tobytes()
+
+
+def _train_gluon_fixed_dense(net_seed, steps):
+    mx.random.seed(net_seed)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(5).randn(4, 6).astype("f"))
+    y = mx.nd.array(np.random.RandomState(6).randn(4, 4).astype("f"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(4)
+    return ([p.data().asnumpy() for p in net.collect_params().values()],
+            None, tr)
+
+
+def test_module_fit_fused_parity(step_env):
+    def fit(fused):
+        step_env(fused)
+        mx.random.seed(0)
+        np.random.seed(0)
+        data = mx.sym.var("data")
+        s = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        s = mx.sym.Activation(s, act_type="relu")
+        s = mx.sym.FullyConnected(s, num_hidden=4, name="fc2")
+        s = mx.sym.SoftmaxOutput(s, name="softmax")
+        X = np.random.RandomState(3).randn(16, 10).astype("f")
+        Y = np.random.RandomState(4).randint(0, 4, (16,)).astype("f")
+        it = mx.io.NDArrayIter(X, Y, batch_size=8,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(s, data_names=("data",),
+                            label_names=("softmax_label",))
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1,
+                                  "momentum": 0.9})
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    a = fit(True)
+    b = fit(False)
+    for k in sorted(a):
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+def test_staged_oracle_unused_paths_intact(step_env):
+    """allreduce_grads()/update() keep the staged halves regardless of
+    the fused-step default (facade contract)."""
+    step_env(True)
+    mx.random.seed(1)
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 3).astype("f"))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.allreduce_grads()
+    tr.update(2)
+    # params moved; no fused program was built for these facades
+    assert tr._updaters[0]._fused_step_owner is None
+
+
+# -- ZeRO-1 ---------------------------------------------------------------
+
+def test_zero1_env_defaults_sharded_trainer(step_env):
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    step_env(True, zero1=True)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    net(mx.nd.array(np.zeros((8, 4), "f")))
+    st = ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                        "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                        mesh=make_mesh({"dp": 8}))
+    assert st._shard_opt
+    g = obs.REGISTRY.get("zero1.shard_params")
+    assert g is not None
+    step_env(True, zero1=False)
+    st2 = ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                         "sgd", {"learning_rate": 0.1,
+                                 "momentum": 0.9},
+                         mesh=make_mesh({"dp": 8}))
+    assert not st2._shard_opt
+    # explicit bool wins over env
+    step_env(True, zero1=True)
+    st3 = ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                         "sgd", {"learning_rate": 0.1,
+                                 "momentum": 0.9},
+                         mesh=make_mesh({"dp": 8}),
+                         shard_optimizer_state=False)
+    assert not st3._shard_opt
+
+
+def _make_sharded_trainer(n_dp, zero1, seed=0, prefix="z1ckpt_"):
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    import jax
+    mx.random.seed(seed)
+    # fixed prefix: every instance names its params identically, so
+    # checkpoints restore across instances and runs compare by key
+    net = gluon.nn.Dense(8, prefix=prefix)   # (8, 8): shardable at 8 & 4
+    net.initialize()
+    net(mx.nd.array(np.zeros((8, 8), "f")))
+    mesh = make_mesh({"dp": n_dp}, jax.devices()[:n_dp])
+    st = ShardedTrainer(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                        "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                        mesh=mesh, shard_optimizer_state=zero1)
+    return st
+
+
+def test_zero1_checkpoint_roundtrip_elastic(tmp_path):
+    """Sharded optimizer state saves through TrainerCheckpoint's
+    two-phase commit and restores bit-identically into BOTH a sharded
+    trainer of a different replica count (elastic 8 -> 4) and a
+    replicated one."""
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    import jax
+    st = _make_sharded_trainer(8, zero1=True)
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype("f"))
+    y = mx.nd.array(np.random.RandomState(1).randn(8, 8).astype("f"))
+    for _ in range(3):
+        st.step(x, y)
+    # momentum state really is dp-sharded (the ZeRO-1 memory claim)
+    from jax.sharding import PartitionSpec
+    sharded = [v for v in st._opt_state.values()
+               if v.sharding.spec == PartitionSpec("dp")]
+    assert sharded, "no opt-state leaf was dp-sharded"
+    want_state = {k: np.asarray(jax.device_get(v)).tobytes()
+                  for k, v in st._opt_state.items()}
+    want_params = {k: np.asarray(jax.device_get(v)).tobytes()
+                   for k, v in st._params.items()}
+    mngr = ckpt.TrainerCheckpoint(tmp_path, async_save=False)
+    mngr.save(st._step_count, st, wait=True)
+    # two-phase commit sealed the step
+    assert mngr.commit_manifest(st._step_count) is not None
+
+    for n_dp, zero1 in ((4, True), (8, False)):
+        tgt = _make_sharded_trainer(n_dp, zero1=zero1)
+        step = mngr.restore_latest(tgt)
+        assert step == st._step_count
+        got_state = {k: np.asarray(jax.device_get(v)).tobytes()
+                     for k, v in tgt._opt_state.items()}
+        got_params = {k: np.asarray(jax.device_get(v)).tobytes()
+                      for k, v in tgt._params.items()}
+        assert got_state == want_state, (n_dp, zero1)
+        assert got_params == want_params, (n_dp, zero1)
+    mngr.close()
+
+
+def test_zero1_matches_replicated_sharded_trainer():
+    """MXTPU_ZERO1 sharding changes memory layout, never numerics."""
+    a = _make_sharded_trainer(8, zero1=True, seed=5)
+    b = _make_sharded_trainer(8, zero1=False, seed=5)
+    x = mx.nd.array(np.random.RandomState(2).randn(8, 8).astype("f"))
+    y = mx.nd.array(np.random.RandomState(3).randn(8, 8).astype("f"))
+    import jax
+    for _ in range(3):
+        a.step(x, y)
+        b.step(x, y)
+    for k in a._params:
+        assert np.asarray(jax.device_get(a._params[k])).tobytes() == \
+            np.asarray(jax.device_get(b._params[k])).tobytes(), k
+
+
+# -- plan signatures ------------------------------------------------------
+
+def test_plan_signature_stability_and_layout_sensitivity():
+    from mxnet_tpu.parallel.bucketing import GradBucketer
+    bk = GradBucketer(target_bytes=1 << 62)
+    items = (("a", (4, 4), "float32", 0, None),
+             ("b", (7,), "float32", -1, None))
+    sig1 = bk.plan_signature(items)
+    sig2 = bk.plan_signature(items)
+    assert sig1 == sig2 and len(sig1) == 16
+    # layout change (key order/priority) -> different signature
+    flipped = (("a", (4, 4), "float32", -1, None),
+               ("b", (7,), "float32", 0, None))
+    assert bk.plan_signature(flipped) != sig1
+    # an already-planned bucket list fingerprints identically
+    assert bk.plan_signature(bk.plan(items)) == sig1
+
+
+def test_fused_update_aot_sig_covers_layout():
+    from mxnet_tpu.parallel import fused_update as fu
+    import jax.numpy as jnp
+    o = opt.create("sgd", learning_rate=0.1)
+    spec = fu._SUPPORTED[type(o)]
+    w = jnp.zeros((10,), jnp.float32)
+    g = jnp.zeros((10,), jnp.float32)
+    s1 = fu._aot_sig(spec, True, True, w, g, (), 0.0, (1, None, 0.0),
+                     layout="aaaa")
+    s2 = fu._aot_sig(spec, True, True, w, g, (), 0.0, (1, None, 0.0),
+                     layout="bbbb")
+    assert s1 != s2

@@ -261,6 +261,11 @@ def _run_gluon_steps(n_steps, batch_size=8):
 
 def test_gluon_5step_jsonl_and_report(tmp_path, monkeypatch):
     out = tmp_path / "telemetry.jsonl"
+    # this test documents the STAGED trainer record shape (allreduce/
+    # optimizer phases, kvstore bytes); the fused one-program step's
+    # record (single "step" phase, no kvstore hop) is covered in
+    # tests/test_fused_step.py
+    monkeypatch.setenv("MXTPU_FUSED_STEP", "0")
     # consume the once-per-process cold-start marker BEFORE the stream
     # opens: run solo, the first trainer step would otherwise publish
     # its source="compile" record into this strict 5-line assertion

@@ -186,12 +186,33 @@ def _run_sweep_worker(args):
             updater.update_all(idxs, pulled, weights)
         jax.block_until_ready([w._data for w in weights])
         ut = (time.perf_counter() - u0) / args.iters
+        # fused one-program step (parallel/fused_step.py): the SAME
+        # exchange+update work as the two staged phases above, in ONE
+        # donated program — the per-row delta is the whole point of
+        # docs/performance.md "Fused train step & ZeRO-1". Bucket size
+        # doesn't change its layout (one flat per lane), so the column
+        # is constant across rows: the staged columns converge toward
+        # it as buckets grow.
+        fupdater = mxopt.get_updater(
+            mxopt.create("sgd", learning_rate=0.01, momentum=0.9))
+        from mxnet_tpu.parallel import fused_step as _fstep
+        ran = _fstep.try_step(fupdater, idxs, grads, weights,
+                              kvstore=kv)      # warmup + compile
+        if not ran:       # not inside assert: python -O must still warm
+            raise RuntimeError("fused step refused the sweep set")
+        jax.block_until_ready([w._data for w in weights])
+        f0 = time.perf_counter()
+        for _ in range(args.iters):
+            _fstep.try_step(fupdater, idxs, grads, weights, kvstore=kv)
+        jax.block_until_ready([w._data for w in weights])
+        ft = (time.perf_counter() - f0) / args.iters
         if rank == 0:
             label = "per-key" if mb <= 0 else "%g MB" % mb
             print("bucket %-8s  collectives/step %3d  exchange %8.2f ms  "
-                  "effective %6.3f GB/s  update %7.2f ms"
+                  "effective %6.3f GB/s  update %7.2f ms  "
+                  "fused-step %7.2f ms"
                   % (label, n_collectives, dt * 1e3, eff_bw / 1e9,
-                     ut * 1e3))
+                     ut * 1e3, ft * 1e3))
         kv.barrier()
     return 0
 

@@ -27,6 +27,14 @@ is rejected):
                           FAIL the gate instead of posting a fake
                           throughput number (docs/fault_tolerance.md)
     --max-anomalies       same, over the anomaly count (skips + spikes)
+    --max-dispatches-per-step
+                          mean exchange+update device programs per
+                          training step (train.step.dispatches deltas;
+                          the fused one-program step reads exactly 1 —
+                          docs/performance.md "Fused train step &
+                          ZeRO-1"). A stream without the metric is a
+                          breach: the gate demanded evidence the
+                          records don't carry
     --max-cold-start-s    worst process boot -> first-useful-dispatch
                           time across the stream's cold-start records
                           (source="compile"; docs/compilation.md) — a
@@ -105,6 +113,13 @@ def evaluate(summary, args):
                        frac is not None and frac <= args.max_data_wait_frac))
     check("skipped_steps", "skipped_steps", args.max_skipped_steps, le)
     check("anomalies", "anomalies", args.max_anomalies, le)
+    # fused-train-step dispatch budget (docs/performance.md "Fused
+    # train step & ZeRO-1"): mean exchange+update device programs per
+    # step. The fused path reads 1.0; a stream WITHOUT the metric
+    # (pre-fused records, non-training sources) is a breach like every
+    # other absent budgeted metric — the gate demanded evidence.
+    check("dispatches_per_step", "dispatches_per_step",
+          args.max_dispatches_per_step, le)
     check("cold_start_s", "cold_start_max_s", args.max_cold_start_s, le)
     check("gateway_success_rate", "gateway_success_rate",
           args.min_success_rate, ge)
@@ -134,6 +149,11 @@ def main(argv=None):
     ap.add_argument("--max-data-wait-frac", type=float, default=None)
     ap.add_argument("--max-skipped-steps", type=float, default=None)
     ap.add_argument("--max-anomalies", type=float, default=None)
+    ap.add_argument("--max-dispatches-per-step", type=float,
+                    default=None,
+                    help="mean exchange+update device programs per "
+                         "training step (fused path = 1; absent "
+                         "metric = breach)")
     ap.add_argument("--max-cold-start-s", type=float, default=None)
     ap.add_argument("--min-success-rate", type=float, default=None)
     ap.add_argument("--max-p99-ms-class", action="append", default=None,
@@ -165,7 +185,8 @@ def main(argv=None):
                args.max_step_mean_s, args.max_compile_stall_s,
                args.max_compiles, args.min_samples_per_sec,
                args.max_data_wait_frac, args.max_skipped_steps,
-               args.max_anomalies, args.max_cold_start_s,
+               args.max_anomalies, args.max_dispatches_per_step,
+               args.max_cold_start_s,
                args.min_success_rate, args.class_p99_budgets or None)
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"

@@ -153,6 +153,17 @@ def summarize(records):
             float(r.get("bucket_pack_seconds", 0.0)) for r in records)
         summary["bucket_unpack_s"] = sum(
             float(r.get("bucket_unpack_seconds", 0.0)) for r in records)
+    # fused train step (docs/performance.md "Fused train step &
+    # ZeRO-1"): device programs per step for exchange+update — reads
+    # 1.0 on the fused path, O(buckets)+O(groups) staged. Only steps
+    # that carry the field count (records from before the metric, or
+    # non-training sources, must not dilute the budgeted mean).
+    disp_steps = [int(r["step_dispatches"]) for r in core
+                  if "step_dispatches" in r]
+    if disp_steps:
+        summary["step_dispatches"] = sum(disp_steps)
+        summary["dispatches_per_step"] = \
+            sum(disp_steps) / len(disp_steps)
     # optimizer section (fused weight update, docs/performance.md):
     # dispatches/step is the O(n_params) -> O(n_groups) headline
     dispatches = sum(int(r.get("update_dispatches", 0)) for r in records)
@@ -482,6 +493,11 @@ def format_summary(s):
                 "unpack %.3fs"
                 % (s["bucket_count"], 100.0 * s.get("bucket_fill_mean", 0),
                    s["bucket_pack_s"], s["bucket_unpack_s"]))
+    if "dispatches_per_step" in s:
+        lines.append(
+            "  step        %d exchange+update program dispatches "
+            "(%.2f/step; fused path = 1)"
+            % (s["step_dispatches"], s["dispatches_per_step"]))
     if "update_dispatches" in s:
         lines.append(
             "  optimizer   %d dispatches (%.1f/step)  fused groups %d  "
