@@ -214,7 +214,8 @@ def _materialize(net, img, nhwc=True):
             p._finish_deferred_init()
 
 
-def _train_tput(ctor, batch, img, steps, unroll, lr=0.1, **trainer_kw):
+def _train_tput(ctor, batch, img, steps, unroll, lr=0.1,
+                flops_per_img=None, **trainer_kw):
     """Train throughput of one model: ALL timed steps run inside ONE
     jitted lax.scan (step_many) — one dispatch per window, fenced by
     fetching the losses to host; device_get is the only reliable fence
@@ -260,6 +261,16 @@ def _train_tput(ctor, batch, img, steps, unroll, lr=0.1, **trainer_kw):
     guard = _numerics.drain_flags()     # timed window's verdicts
     st.bench_skipped_steps = guard["skipped_steps"]
     st.bench_anomalies = guard["anomalies"]
+    if flops_per_img:
+        # charge the timed window's analytic model FLOPs (fwd+bwd) to
+        # the goodput counter and derive the headline MFU — step_many's
+        # scanned window never dispatches per-step costed programs, so
+        # the fused step only self-charges its optimizer phase
+        from mxnet_tpu.observability import goodput as _goodput
+        flops = float(flops_per_img) * batch * steps
+        if _goodput.enabled():
+            _goodput.note_flops(flops, n_dispatches=steps)
+        st.bench_mfu = _goodput.mfu_value(flops, dt, source="bench")
     return batch * steps / dt, st
 
 
@@ -692,6 +703,66 @@ def _numerics_overhead_pct(steps=150, warmup=30):
     return round(100.0 * (t_on - t_off) / t_off, 2)
 
 
+def _ledger_mb():
+    """HBM-ledger resident MiB at call time (0.0 when the plane is
+    off): the BENCH record's model-footprint field."""
+    from mxnet_tpu.observability import memory as _memory
+    return _memory.total_bytes() / (1024.0 * 1024.0)
+
+
+def _memledger_overhead_pct(steps=120, warmup=20):
+    """Happy-path cost of the HBM-ledger/goodput plane (the ISSUE-17
+    acceptance number): time a dispatch-bound fused-step loop with
+    MXTPU_MEMLEDGER on vs off and report the overhead percentage. The
+    plane's per-dispatch cost is an oom_guard enter/exit, a cost-table
+    lookup, and two counter bumps — so a tiny one-dispatch-per-call
+    loop upper-bounds the big-model cost exactly like the numerics
+    probe above. MXTPU_BENCH_MEMLEDGER_PROBE=0 skips it."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import fused_step as _fstep
+
+    rng = np.random.RandomState(0)
+    shapes = [(64, 64)] * 6 + [(64,)] * 6
+
+    def loop(env_on):
+        os.environ["MXTPU_MEMLEDGER"] = "1" if env_on else "0"
+        try:
+            ws = [mx.nd.array(rng.randn(*s).astype("float32"))
+                  for s in shapes]
+            gs = [mx.nd.array(rng.randn(*s).astype("float32"))
+                  for s in shapes]
+            upd = opt.get_updater(opt.create("sgd", learning_rate=1e-6,
+                                             momentum=0.9))
+            idx = list(range(len(ws)))
+            for _ in range(warmup):
+                if not _fstep.try_step(upd, idx, gs, ws):
+                    raise RuntimeError("fused step refused — the "
+                                       "memledger probe measures its "
+                                       "dispatch wrapper")
+            import jax
+            jax.block_until_ready([w._data for w in ws])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                _fstep.try_step(upd, idx, gs, ws)
+            jax.block_until_ready([w._data for w in ws])
+            return time.perf_counter() - t0
+        finally:
+            os.environ.pop("MXTPU_MEMLEDGER", None)
+    prev = os.environ.get("MXTPU_MEMLEDGER")
+    try:
+        # interleaved min-of-5, same rationale as the numerics probe
+        t_on, t_off = [], []
+        for _ in range(5):
+            t_off.append(loop(False))
+            t_on.append(loop(True))
+        t_off, t_on = min(t_off), min(t_on)
+    finally:
+        if prev is not None:
+            os.environ["MXTPU_MEMLEDGER"] = prev
+    return round(100.0 * (t_on - t_off) / t_off, 2)
+
+
 def _measure_main():
     t_start = time.perf_counter()
     _apply_platform_override()
@@ -705,7 +776,12 @@ def _measure_main():
     unroll = int(os.environ.get("MXTPU_BENCH_UNROLL", 10))
     img_s, st = _train_tput(
         lambda: vision.resnet50_v1(classes=1000, layout="NHWC"),
-        BATCH, IMG, STEPS, unroll)
+        BATCH, IMG, STEPS, unroll,
+        # resnet50 @224 fwd ~4.089 GFLOP/img, train ~3x fwd (the same
+        # accounting tools/mfu_probe.py documents); conv FLOPs scale
+        # with spatial area, so shrunk-IMG CI rungs scale the constant
+        # instead of posting a fantasy MFU
+        flops_per_img=3 * 4.089e9 * (IMG / 224.0) ** 2)
     net = st._net
 
     extra = {}
@@ -745,6 +821,11 @@ def _measure_main():
             extra["numerics_overhead_pct"] = _numerics_overhead_pct()
         except Exception as e:  # noqa: BLE001 — recorded, not fatal
             extra["numerics_overhead_error"] = str(e)[:200]
+    if _flag("MXTPU_BENCH_MEMLEDGER_PROBE") and STEPS >= 10:
+        try:
+            extra["memledger_overhead_pct"] = _memledger_overhead_pct()
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            extra["memledger_overhead_error"] = str(e)[:200]
     if _PROBE_INFO["probes"]:
         # non-ladder parent measured in-process: its record carries the
         # probe/lease outcome directly (rung children never probe —
@@ -770,6 +851,12 @@ def _measure_main():
         # was ZeRO-1-sharded over dp (MXTPU_ZERO1) for this number
         "fused_step": True,
         "zero1": bool(getattr(st, "_shard_opt", False)),
+        # goodput/memory plane (docs/observability.md "Goodput & MFU" /
+        # "Memory ledger"): model-FLOPs utilization of the timed window
+        # against the platform's peak, and the HBM ledger's resident
+        # bytes at record time — 0.0 with MXTPU_MEMLEDGER=0
+        "mfu": round(float(getattr(st, "bench_mfu", 0.0)), 4),
+        "hbm_mb": round(_ledger_mb(), 2),
         "extra": extra}))
 
 

@@ -196,11 +196,27 @@ def compile_fresh(jitted, abstract_args):
 def export_jit(store, name, jitted, abstract_args, extra_key):
     """Lower + compile `jitted` for `abstract_args` ahead of time and
     persist the executable under `name`. Returns (fingerprint, bytes
-    written)."""
+    written). Registration doubles as the observability capture point:
+    the fresh Compiled's memory_analysis()/cost_analysis() feed the
+    HBM ledger's per-program working sets and the goodput FLOP table
+    (docs/observability.md "Memory ledger" / "Goodput & MFU")."""
     fp = fingerprint(extra_key)
     compiled = compile_fresh(jitted, abstract_args)
+    record_analyses(name, compiled)
     nbytes = store.put(name, fp, compiled)
     return fp, nbytes
+
+
+def record_analyses(name, compiled):
+    """Best-effort memory/cost capture for a freshly compiled
+    executable (shared by export_jit and the fused-step registration)."""
+    try:
+        from ..observability import goodput as _goodput
+        from ..observability import memory as _memory
+        _memory.record_program(name, compiled)
+        _goodput.record_cost(name, compiled)
+    except Exception:   # noqa: BLE001 — analysis must never break export
+        pass
 
 
 class ArtifactStore:

@@ -125,6 +125,22 @@ class Trainer:
             # otherwise
             from ..observability import httpz as _httpz
             _httpz.maybe_start()
+            self._register_param_bytes()
+
+    def _register_param_bytes(self):
+        """One-time HBM-ledger cell for the trainable set (runs at the
+        same lazy boundary as _resolve_sync, when deferred shapes are
+        materialized). ZeRO-1 optimizer-state bytes ride a separate
+        cell owned by the fused step."""
+        from ..observability import memory as _memory
+        if not _memory.enabled():
+            return
+        try:
+            nb = _memory.nbytes([p.data()._data
+                                 for _i, p in self._trainable()])
+        except Exception:   # a param still deferred: skip, not fatal
+            return
+        _memory.set_bytes("trainer", "trainer", "params", nb)
 
     def _trainable(self):
         """(slot, param) pairs that actually carry gradients."""

@@ -33,15 +33,21 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "TraceContext", "trace_span", "record_span",
            "device_annotation", "trace",
            "StepTimer", "stream_path", "stream_enabled", "emit",
-           "close_stream", "ObservabilityServer", "debug_snapshot"]
+           "close_stream", "ObservabilityServer", "debug_snapshot",
+           "memory", "goodput"]
 
 
 def __getattr__(name):
     # the live-plane server pulls in http.server; keep that chain out
     # of `import mxnet_tpu` (cold start is a gated metric) — every
-    # runtime call site already imports httpz lazily too
+    # runtime call site already imports httpz lazily too. memory/
+    # goodput stay lazy for the same reason plus import-cycle safety
+    # (memory reaches into resilience.chaos at oom_guard time)
     if name in ("ObservabilityServer", "debug_snapshot"):
         from . import httpz
         return getattr(httpz, name)
+    if name in ("memory", "goodput"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))

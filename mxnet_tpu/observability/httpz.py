@@ -8,7 +8,9 @@ can be diagnosed with ``curl`` instead of a debugger:
 
     GET /metricsz   Prometheus text exposition of the process registry
     GET /debugz     JSON process snapshot: queue depths, resident
-                    models, lease holder, compile/AOT counters, trace
+                    models, the HBM ledger's memory section (per-model
+                    bytes, top consumers, headroom) + goodput program
+                    costs, lease holder, compile/AOT counters, trace
                     plane state, and every thread's current stack
     GET /healthz    liveness
 
@@ -60,11 +62,19 @@ def debug_snapshot(extra=None):
     `extra` (the gateway passes admission queues, registry residency,
     decode slot occupancy) is merged in under its own keys."""
     from ..resilience import lease as _lease
+    from . import goodput as _goodput
+    from . import memory as _memory
     snap = {
         "pid": os.getpid(),
         "rank": _trace.current_rank(),
         "uptime_s": time.time() - _BOOT,
         "lease": _lease.held_state(),
+        # the HBM ledger's /statusz section (docs/observability.md
+        # "Memory ledger"): per-model resident bytes, ranked top
+        # consumers, per-program working sets, headroom
+        "memory": _memory.debug_section(),
+        "goodput": {"costs": _goodput.costs_snapshot(),
+                    "peak_flops": _goodput.peak_flops()},
         "compile": {
             "xla_compiles": _counter_value("xla.compile.count"),
             "cache_hits": _counter_value("compile.cache.hits"),

@@ -217,6 +217,9 @@ def _counters_snapshot():
         # O(buckets)+O(groups) staged; perf_gate budgets it via
         # --max-dispatches-per-step
         "step_dispatches": _counter_total("train.step.dispatches"),
+        # goodput plane (observability/goodput.py): model FLOPs charged
+        # by dispatches this window — the per-step MFU numerator
+        "step_flops": _counter_total("goodput.flops"),
     }
 
 
@@ -352,10 +355,20 @@ class StepTimer:
                       "bucket_unpack_seconds", "update_dispatches",
                       "fused_groups", "fused_pack_seconds",
                       "fused_update_seconds", "skipped_steps",
-                      "anomalies", "step_dispatches"):
+                      "anomalies", "step_dispatches", "step_flops"):
             delta = snap[field] - prev.get(field, 0)
             if delta:
                 record[field] = delta
+        # per-step MFU (observability/goodput.py): derived from the
+        # FLOP delta over this step's peak-FLOP envelope; absent when
+        # no program charged the goodput counter (pre-goodput streams
+        # keep their shape)
+        if record.get("step_flops") and step_time > 0:
+            from . import goodput as _goodput
+            mfu = _goodput.mfu_value(record["step_flops"], step_time,
+                                     source=self.source)
+            if mfu is not None:
+                record["mfu"] = mfu
         # current loss scale rides along once a GradScaler armed it —
         # a gauge, not a delta (absent on unscaled runs)
         scale_gauge = REGISTRY.get("numerics.loss_scale")

@@ -275,6 +275,26 @@ class ShardedTrainer:
                 k: jax.device_put(
                     jnp.zeros((n_dp,) + v.shape, jnp.float32), sh)
                 for k, v in self._params.items()}
+        self._register_ledger_bytes()
+
+    def _register_ledger_bytes(self):
+        """HBM-ledger cells for this trainer's resident device state
+        (docs/observability.md "Memory ledger"): params, aux stats and
+        optimizer state are all committed at __init__ exit. Sharded
+        layouts report LOGICAL bytes (the per-device sum equals this),
+        matching how the gluon trainer accounts its ZeRO-1 cell."""
+        from ..observability import memory as _memory
+        if not _memory.enabled():
+            return
+        _memory.set_bytes("trainer", "sharded_trainer", "params",
+                          _memory.nbytes(self._params))
+        if self._aux:
+            _memory.set_bytes("trainer", "sharded_trainer", "aux",
+                              _memory.nbytes(self._aux))
+        state_leaves = jax.tree.leaves(self._opt_state)
+        if state_leaves:
+            _memory.set_bytes("trainer", "sharded_trainer", "opt_state",
+                              _memory.nbytes(state_leaves))
 
     def _dp_axis_name(self):
         return "dp" if "dp" in self._mesh.axis_names \

@@ -73,6 +73,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..base import getenv
 from ..compile import aot as _aot
+from ..observability import goodput as _goodput
+from ..observability import memory as _memory
 from ..observability import registry as _obs
 from .. import optimizer as opt
 from ..resilience import numerics as _num
@@ -176,6 +178,7 @@ class FusedTrainStep:
         self._state_flats = {}
         self._gather_fn = {}      # (shape, dtype, mesh) -> gather jit
         self._gauge_val = None    # last zero1.shard_params value set
+        self._cost_name = {}      # signature -> goodput program name
 
     # -- public ----------------------------------------------------------
     def program_count(self):
@@ -222,8 +225,10 @@ class FusedTrainStep:
         packed = self._pack(lanes, sig, nproc, mesh, zero1)
         fn = self._program_for(sig, lanes, packed, nproc, mesh, zero1,
                                guard, donate)
-        new_w, new_states, ok = fn(*packed)
+        with _memory.oom_guard("train.step", "trainer"):
+            new_w, new_states, ok = fn(*packed)
         STEP_DISPATCHES.inc()
+        self._charge_goodput(sig, lanes, nproc)
         n_sharded = sum(len(l.group) for l in lanes) if zero1 else 0
         if n_sharded != self._gauge_val:
             self._gauge_val = n_sharded
@@ -233,6 +238,49 @@ class FusedTrainStep:
             _num.record_flag(ok, keys=keys, where="step")
         self._unpack(lanes, new_w, new_states, sig, nproc, zero1)
         return True
+
+    def _charge_goodput(self, sig, lanes, nproc):
+        """Charge the step program's FLOPs to the goodput ledger.
+        XLA-measured cost (cost_analysis via the AOT capture path)
+        wins; the JIT-only path falls back to the analytic
+        `update_cost` model over the packed element count, plus the
+        cross-replica sum on multi-process meshes."""
+        if not _goodput.enabled():
+            return
+        name = self._cost_name.get(sig)
+        if name is None:
+            name = "fused_step/sig%d" % len(self._cost_name)
+            self._cost_name[sig] = name
+        if _goodput.cost(name) is None:
+            from .fused_update import update_cost
+            o = self._updater.optimizer
+            flops = 0.0
+            for l in lanes:
+                n = int(l.bucket.total)
+                itemsize = int(l.group[0].pack_w.dtype.itemsize)
+                c = update_cost(o, n, itemsize)
+                if c is not None:
+                    flops += float(c.get("flops", 0))
+                if nproc > 1:    # the in-program gradient sum
+                    flops += float(n) * (nproc - 1)
+            _goodput.record_cost(name, flops=flops)
+        _goodput.note_dispatch(name)
+
+    def _carried_state_bytes(self):
+        """Live device bytes of the ZeRO-1 carried state flats —
+        addressable shards only, so the ledger reflects the 1/N
+        per-replica share ZeRO-1 actually holds."""
+        total = 0
+        for _sig, (_meta, flats) in self._state_flats.items():
+            for lane_flats in flats:
+                for f in lane_flats:
+                    shards = getattr(f, "addressable_shards", None)
+                    if shards:
+                        total += sum(int(s.data.nbytes)
+                                     for s in shards)
+                    else:
+                        total += int(getattr(f, "nbytes", 0))
+        return total
 
     def flush_state(self):
         """All-gather any ZeRO-1-sharded state flats back into the
@@ -252,12 +300,14 @@ class FusedTrainStep:
                                            bucket.unpack(full)):
                         leaves[s]._data = sub
         self._state_flats.clear()
+        _memory.release("trainer", "optimizer", "zero1_state")
         ZERO1_ALLGATHER_SECONDS.observe(time.perf_counter() - t0)
 
     def drop_state(self):
         """Forget carried state flats WITHOUT syncing (set_states just
         replaced the authoritative per-key states)."""
         self._state_flats.clear()
+        _memory.release("trainer", "optimizer", "zero1_state")
 
     # -- exchange topology ----------------------------------------------
     def _exchange_plan(self, kvstore):
@@ -493,6 +543,7 @@ class FusedTrainStep:
             try:
                 avals = _aot.abstract(packed)
                 compiled = _aot.compile_fresh(jitted, avals)
+                _aot.record_analyses(name, compiled)
                 store.put(name, _aot.fingerprint(extra), compiled)
                 loaded = compiled
             except Exception:   # noqa: BLE001 — capture is best-effort
@@ -500,6 +551,10 @@ class FusedTrainStep:
         if loaded is None:
             return jitted
         self._aot[sig] = loaded
+        # a loaded executable still answers cost/memory analysis —
+        # register under the program name so MFU uses measured FLOPs
+        _aot.record_analyses(name, compiled=loaded)
+        self._cost_name[sig] = name
 
         def call(*args):
             try:
@@ -548,6 +603,8 @@ class FusedTrainStep:
                         e.state_leaves[s]._data = s_sub
         if zero1:
             self._state_flats = {sig: (lanes_meta, kept)}
+            _memory.set_bytes("trainer", "optimizer", "zero1_state",
+                              self._carried_state_bytes())
         UNPACK_SECONDS.observe(time.perf_counter() - t0)
 
 

@@ -60,7 +60,13 @@ fault is one 500 response, the gateway keeps serving), `lease.acquire`
 (before a
 `DeviceLease.acquire` touches the lease file), `device.init`
 (before `HealthWatchdog.init_devices` probes the backend — kind=sleep
-exercises the init deadline), and the array-corruption sites
+exercises the init deadline), `memory.oom` (inside every
+`memory.oom_guard`-wrapped device dispatch — engine infer, decode
+prefill/step, the fused train step; a tripped fault is converted to a
+simulated RESOURCE_EXHAUSTED so the HBM-ledger forensics dump and the
+typed `HBMExhausted` re-raise can be drilled without exhausting a real
+chip — docs/observability.md "Memory ledger"), and the
+array-corruption sites
 `grad.post` / `weight.post` (`corrupt_point` in the fused update:
 kind=nan / kind=bitflip mutate the packed flats — the numerics-guard
 proof sites, docs/fault_tolerance.md "Training numerics guard"). A

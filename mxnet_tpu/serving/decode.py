@@ -43,6 +43,8 @@ import numpy as np
 from ..base import MXNetError, getenv
 from ..compile import aot as _aot
 from ..compile.cache import enable_cache
+from ..observability import goodput as _goodput
+from ..observability import memory as _memory
 from ..observability import registry as _obs
 from ..observability import trace as _trace
 from .engine import bucket_sizes, resolve_serve_dtype
@@ -162,6 +164,19 @@ class DecodeEngine:
         self.positions = np.zeros((self.max_slots,), np.int64)
         self.active = np.zeros((self.max_slots,), bool)
         self.tokens = np.zeros((self.max_slots,), np.int64)
+        self._ledger_sync()
+
+    def _ledger_sync(self):
+        """Reconcile this engine's HBM-ledger cells with the buffers it
+        actually holds — params, the statically-shaped KV cache (the
+        dominant cell at scale), and the position vector."""
+        _memory.set_bytes(self.name, "decode", "params",
+                          _memory.nbytes(self._params))
+        _memory.set_bytes(self.name, "decode", "kv_cache",
+                          int(self._cache_k.nbytes)
+                          + int(self._cache_v.nbytes))
+        _memory.set_bytes(self.name, "decode", "positions",
+                          int(self._positions.nbytes))
 
     @property
     def free_slots(self):
@@ -212,6 +227,7 @@ class DecodeEngine:
         its HBM/host budget. The cache dominates at scale: it is
         allocated for max_slots whether or not any sequence is
         active."""
+        self._ledger_sync()      # ledger and budget agree by definition
         total = sum(int(v.nbytes) for v in self._params.values())
         total += int(self._cache_k.nbytes) + int(self._cache_v.nbytes)
         total += int(self._positions.nbytes)
@@ -394,7 +410,8 @@ class DecodeEngine:
         # prefill + admit run under the requesting trace's
         # TraceAnnotation (the scheduler restores the submit context),
         # so the XLA profiler names which request's prefill this is
-        with _trace.device_annotation():
+        with _memory.oom_guard("decode.prefill", self.name), \
+                _trace.device_annotation():
             out = self._aot_call(("prefill", bucket), args)
             if out is None:
                 out = self._prefill_jit(*args)
@@ -407,6 +424,7 @@ class DecodeEngine:
                 admitted = self._admit_jit(*admit_args)
                 self._count_compile("admit")
         self._cache_k, self._cache_v, self._positions = admitted
+        self._charge_goodput("prefill", bucket=bucket)
         first = int(next_token)
         self.positions[slot] = n
         self.active[slot] = True
@@ -430,12 +448,14 @@ class DecodeEngine:
             active = jax.device_put(active, self.device)
         step_args = (self._params, self._cache_k, self._cache_v,
                      self._positions, active, tokens)
-        stepped = self._aot_call("step", step_args)
-        if stepped is None:
-            stepped = self._step_jit(*step_args)
-            self._count_compile("step")
+        with _memory.oom_guard("decode.step", self.name):
+            stepped = self._aot_call("step", step_args)
+            if stepped is None:
+                stepped = self._step_jit(*step_args)
+                self._count_compile("step")
         (self._cache_k, self._cache_v, self._positions,
          next_tokens) = stepped
+        self._charge_goodput("step", tokens=self.max_slots)
         out = np.asarray(next_tokens)
         self.positions[self.active] += 1
         self.tokens[self.active] = out[self.active]
@@ -443,6 +463,21 @@ class DecodeEngine:
         _STEP_SECONDS.observe(time.perf_counter() - t0,
                               engine=self.name)
         return out
+
+    def _charge_goodput(self, kind, bucket=None, tokens=None):
+        """Charge one dispatch's FLOPs to the goodput ledger under the
+        program's AOT name. XLA-measured cost (registered at AOT
+        export) wins; otherwise the standard decoder-FLOPs estimate
+        2 * n_params * n_tokens."""
+        if not _goodput.enabled():
+            return
+        name = self._aot_name(kind, bucket)
+        if _goodput.cost(name) is None:
+            n_elems = sum(int(v.size) for v in self._params.values())
+            n_tok = int(tokens if tokens is not None
+                        else (bucket or 1))
+            _goodput.record_cost(name, flops=2.0 * n_elems * n_tok)
+        _goodput.note_dispatch(name)
 
     def retire(self, slot):
         """Free a slot between steps (sequence finished or evicted).

@@ -35,6 +35,8 @@ from ..compile import aot as _aot
 from ..compile.cache import enable_cache
 from ..graph import build_graph_fn, collect_vars, infer_structs
 from ..ndarray import NDArray
+from ..observability import goodput as _goodput
+from ..observability import memory as _memory
 from ..observability import registry as _obs
 from ..observability import trace as _trace
 
@@ -197,6 +199,13 @@ class InferenceEngine:
         self._placed = {}           # device-key -> (params, aux) copies
         self._aot = {}              # bucket -> deserialized executable
         self._aot_device = None     # the device the executables target
+        # HBM ledger (docs/observability.md "Memory ledger"): a freeze
+        # is an allocation event — params/aux land attributed before
+        # the first request arrives
+        _memory.set_bytes(self.name, "engine", "params",
+                          _memory.nbytes(self._params))
+        _memory.set_bytes(self.name, "engine", "aux",
+                          _memory.nbytes(self._aux))
 
     # ------------------------------------------------------------------
     # constructors
@@ -321,15 +330,21 @@ class InferenceEngine:
         placed copy — the number a model-multiplexing registry accounts
         against its HBM/host budget (docs/serving.md "Front door &
         multiplexing"). Request/activation buffers are step-local
-        (donated) and not counted."""
-        total = sum(int(v.nbytes) for v in self._params.values())
-        total += sum(int(v.nbytes) for v in self._aux.values())
+        (donated) and not counted. Every measurement reconciles the
+        HBM ledger's (model, engine, *) cells, so the gateway's
+        budgeted LRU and `memory.hbm.*` report the same number."""
+        params_b = sum(int(v.nbytes) for v in self._params.values())
+        aux_b = sum(int(v.nbytes) for v in self._aux.values())
         with self._lock:
             placed = list(self._placed.values())
+        replica_b = 0
         for params, aux in placed:
-            total += sum(int(v.nbytes) for v in params.values())
-            total += sum(int(v.nbytes) for v in aux.values())
-        return total
+            replica_b += sum(int(v.nbytes) for v in params.values())
+            replica_b += sum(int(v.nbytes) for v in aux.values())
+        _memory.set_bytes(self.name, "engine", "params", params_b)
+        _memory.set_bytes(self.name, "engine", "aux", aux_b)
+        _memory.set_bytes(self.name, "engine", "replicas", replica_b)
+        return params_b + aux_b + replica_b
 
     def bucket_for(self, n):
         """Smallest padding bucket that holds `n` rows."""
@@ -364,6 +379,11 @@ class InferenceEngine:
                 self._aux[n] = staged(aux_params[n])
         with self._lock:
             self._placed = {}     # per-device copies are now stale
+        _memory.set_bytes(self.name, "engine", "params",
+                          _memory.nbytes(self._params))
+        _memory.set_bytes(self.name, "engine", "aux",
+                          _memory.nbytes(self._aux))
+        _memory.release(self.name, "engine", "replicas")
 
     # ------------------------------------------------------------------
     # ahead-of-time executables (docs/compilation.md)
@@ -530,6 +550,7 @@ class InferenceEngine:
         if device is None:
             return self._params, self._aux
         key = device.id
+        fresh = None
         with self._lock:
             placed = self._placed.get(key)
             if placed is None:
@@ -538,6 +559,11 @@ class InferenceEngine:
                           {n: jax.device_put(v, device)
                            for n, v in self._aux.items()})
                 self._placed[key] = placed
+                fresh = _memory.nbytes(list(self._placed.values()))
+        if fresh is not None:
+            # a new replica copy is an allocation event: the ledger's
+            # replicas cell tracks the aggregate across devices
+            _memory.set_bytes(self.name, "engine", "replicas", fresh)
         return placed
 
     def _stage_static(self, x, name, shape, dtype, device):
@@ -654,8 +680,11 @@ class InferenceEngine:
         aot_fn = self._aot_fn_for(bucket, device)
         # device dispatch rides a jax TraceAnnotation named by the
         # caller's trace id (the server attaches the request context),
-        # so XLA profiler device rows correlate with the host spans
-        with _trace.device_annotation():
+        # so XLA profiler device rows correlate with the host spans.
+        # The oom_guard turns a RESOURCE_EXHAUSTED here into a typed
+        # HBMExhausted with the ranked ledger dumped first
+        with _memory.oom_guard("engine.infer", self.name), \
+                _trace.device_annotation():
             if aot_fn is not None:
                 try:
                     # the AOT-loaded executable: no trace, no compile —
@@ -692,9 +721,25 @@ class InferenceEngine:
         keep = None if n == bucket else n
         result = [NDArray(o[:keep] if keep is not None else o)
                   for o in outs]
+        self._charge_goodput(bucket)
         _INFER_SECONDS.observe(time.perf_counter() - t0,
                                engine=self.name)
         return result
+
+    def _charge_goodput(self, bucket):
+        """Charge this dispatch's model FLOPs to the goodput counter.
+        Measured cost lands at AOT export (compile.aot registers
+        cost_analysis per program); the first JIT-only dispatch
+        registers the dense-forward analytic estimate — 2 FLOPs per
+        parameter element per padded row."""
+        if not _goodput.enabled():
+            return
+        name = self._aot_name(bucket)
+        if _goodput.cost(name) is None:
+            n_elems = sum(int(v.size) for v in self._params.values())
+            _goodput.record_cost(name,
+                                 flops=2.0 * n_elems * int(bucket))
+        _goodput.note_dispatch(name)
 
     def zero_inputs(self, n=1):
         """A zero-filled request batch of `n` rows (static inputs at

@@ -32,6 +32,7 @@ import threading
 import time
 
 from ...base import MXNetError, getenv
+from ...observability import memory as _memory
 from ...observability import registry as _obs
 from ...observability import telemetry as _telemetry
 from .. import health as _health
@@ -412,6 +413,18 @@ class ModelRegistry:
         self._evict_to_fit()
         return self
 
+    def _release_ledger(self, server, name):
+        """Zero the HBM-ledger cells of an evicted/drained model —
+        every engine name the server registered under, plus the
+        registry name itself (they usually coincide)."""
+        try:
+            models = set(server.ledger_models())
+        except Exception:   # noqa: BLE001 — a bare engine, best-effort
+            models = set()
+        models.add(name)
+        for m in models:
+            _memory.release(m)
+
     def _update_gauges_locked(self):
         resident = [e for e in self._entries.values()
                     if e.state == "resident"]
@@ -471,6 +484,9 @@ class ModelRegistry:
                 self._evict_threads.append(th)
                 th.start()
             _EVICTIONS.inc(model=v.name)
+            # the ledger and the budget accounting drop together: the
+            # victim's cells go to zero the moment it leaves residency
+            self._release_ledger(server, v.name)
 
     def evict(self, name, timeout=None):
         """Explicit unload (admin surface). True when the model was
@@ -485,6 +501,7 @@ class ModelRegistry:
             self._update_gauges_locked()
             self._cond.notify_all()
         _EVICTIONS.inc(model=name)
+        self._release_ledger(server, name)
         return server.drain(timeout)
 
     # ------------------------------------------------------------------
@@ -589,6 +606,7 @@ class ModelRegistry:
             wait = None if deadline is None \
                 else max(0.0, deadline - time.perf_counter())
             ok = server.drain(wait) and ok
+            self._release_ledger(server, name)
         for th in evictions:
             wait = None if deadline is None \
                 else max(0.0, deadline - time.perf_counter())

@@ -819,6 +819,15 @@ class ModelServer:
                        for s in self._schedulers)
         return self.engine.device_bytes()
 
+    def ledger_models(self):
+        """HBM-ledger model names this server's engines registered
+        their cells under (per-device decode replicas carry derived
+        names) — the gateway registry releases exactly these at
+        eviction so the ledger drops with the budget accounting."""
+        if self.kind == "decode":
+            return sorted({s.engine.name for s in self._schedulers})
+        return [self.engine.name]
+
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------

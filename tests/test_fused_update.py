@@ -27,10 +27,24 @@ from mxnet_tpu.parallel import fused_update as fu
 
 
 @pytest.fixture
-def fused_env(monkeypatch):
+def fused_env(monkeypatch, tmp_path):
+    """MXTPU_FUSED_UPDATE toggle + a COLD per-test XLA compilation
+    cache. The session conftest latches the shared
+    ``$TMPDIR/mxtpu_xla_cache_<uid>`` dir for the whole process; a
+    rerun against that warm cache serves executables from disk instead
+    of compiling, so compile-count/donation/dispatch expectations that
+    held on the first (cold) run could nondeterministically flip on
+    the second. Pointing ``jax_compilation_cache_dir`` at a fresh
+    tmp_path makes every parity test compile from scratch regardless
+    of what earlier sessions left in the shared cache."""
+    import jax
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+
     def set_fused(on):
         monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1" if on else "0")
-    return set_fused
+    yield set_fused
+    jax.config.update("jax_compilation_cache_dir", prev)
 
 
 SHAPES = [(5, 3), (7,), (4, 4), (2, 2, 2), (11,)]

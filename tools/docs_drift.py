@@ -1,21 +1,29 @@
-"""Fail when docs/observability.md and the emitted metrics drift apart.
+"""Fail when the docs and the code's observable surfaces drift apart.
 
     python tools/docs_drift.py            # exit 1 on drift
-    python tools/docs_drift.py --list     # print both sets
+    python tools/docs_drift.py --list     # print every audited set
 
-Two sources of truth that must agree:
+Three code/docs pairs that must agree:
 
-1. **Code**: every literal metric name passed to
+1. **Metrics**: every literal metric name passed to
    ``counter("...")`` / ``gauge("...")`` / ``histogram("...")``
-   anywhere under ``mxnet_tpu/``;
-2. **Docs**: the "Currently wired" metric table in
-   ``docs/observability.md`` (first column; ``/ .suffix`` shorthand
+   anywhere under ``mxnet_tpu/`` vs the "Currently wired" metric table
+   in ``docs/observability.md`` (first column; ``/ .suffix`` shorthand
    rows expand against the previous full name — `` `a.b.c` / `.d` ``
    documents ``a.b.c`` and ``a.b.d``).
+2. **Perf-gate budgets**: every ``--flag`` tools/perf_gate.py's
+   argparse registers vs the flags named in the "Perf gate" section of
+   ``docs/observability.md`` — a budget CI can assert must be
+   documented, and a documented budget must exist.
+3. **Chaos sites**: every literal site passed to ``chaos_point`` /
+   ``corrupt_point`` (plus the ``sites=(...)`` guard default) vs the
+   site table in ``docs/fault_tolerance.md``. Doc rows with a
+   placeholder (``serving.replica<k>.dispatch``) describe dynamically
+   composed sites and are exempt from the literal match.
 
-A metric emitted but undocumented, or documented but no longer
-emitted, exits 1 naming each offender — wired as a fast test
-(tests/test_tracing.py), so the table cannot rot. Stdlib-only.
+Anything emitted but undocumented, or documented but no longer in the
+code, exits 1 naming each offender — wired as a fast test
+(tests/test_tracing.py), so the tables cannot rot. Stdlib-only.
 """
 from __future__ import annotations
 
@@ -26,6 +34,8 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(ROOT, "docs", "observability.md")
+CHAOS_DOC = os.path.join(ROOT, "docs", "fault_tolerance.md")
+PERF_GATE = os.path.join(ROOT, "tools", "perf_gate.py")
 SRC = os.path.join(ROOT, "mxnet_tpu")
 
 #: a literal first argument to counter(/gauge(/histogram( — matches
@@ -92,35 +102,148 @@ def doc_metrics(doc=DOC):
     return names
 
 
+#: perf_gate's argparse registrations: every literal ``--flag``
+_FLAG_RE = re.compile(r"add_argument\(\s*[\"'](--[a-z][a-z0-9-]*)[\"']")
+
+#: any ``--flag`` token in the docs' Perf gate section (backticked
+#: prose and the bash example both count)
+_DOC_FLAG_RE = re.compile(r"(--[a-z][a-z0-9-]*)")
+
+#: a literal site reaching chaos_point/corrupt_point — direct calls
+#: AND the retry_call(chaos_point, "io.read") spelling
+_SITE_RE = re.compile(
+    r"(?:chaos_point|corrupt_point)\b[^\"'\n]*"
+    r"[\"']([a-z][a-z0-9_.]*)[\"']")
+
+#: the watchdog guard's default site tuple (serving/health.py)
+_SITES_KW_RE = re.compile(r"sites=\(\s*[\"']([a-z][a-z0-9_.]*)[\"']")
+
+
+def perf_gate_flags(path=PERF_GATE):
+    """Every budget flag tools/perf_gate.py registers."""
+    with open(path) as f:
+        return set(_FLAG_RE.findall(f.read()))
+
+
+def doc_perf_gate_flags(doc=DOC):
+    """Flags named in docs/observability.md's "Perf gate" section."""
+    with open(doc) as f:
+        lines = f.readlines()
+    flags, in_section = set(), False
+    for line in lines:
+        if line.startswith("## "):
+            in_section = line.startswith("## Perf gate")
+            continue
+        if in_section:
+            flags.update(_DOC_FLAG_RE.findall(line))
+    return flags
+
+
+def code_chaos_sites(src=SRC):
+    """Every literal chaos/corruption site wired under mxnet_tpu/
+    (resilience/chaos.py itself is skipped: its docstring narrates
+    sites without wiring any)."""
+    sites = set()
+    for dirpath, dirnames, filenames in os.walk(src):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py") or fn == "chaos.py":
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                text = f.read()
+            for rx in (_SITE_RE, _SITES_KW_RE):
+                sites.update(m.group(1) for m in rx.finditer(text)
+                             if "." in m.group(1))
+    return sites
+
+
+def doc_chaos_sites(doc=CHAOS_DOC):
+    """Site names from the first column of the fault_tolerance.md
+    injection-site table (the table whose header cell is "site").
+    Returns (literal_sites, dynamic_sites) — rows carrying a ``<k>``
+    placeholder are composed at runtime and can't be literal-matched."""
+    with open(doc) as f:
+        lines = f.readlines()
+    literal, dynamic = set(), set()
+    in_table = False
+    for line in lines:
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = stripped.split("|")
+        if len(cells) < 3:
+            continue
+        first = cells[1].strip()
+        if first == "site":
+            in_table = True
+            continue
+        if not in_table or set(first) <= set("-: "):
+            continue
+        m = re.search(r"`([a-z][a-z0-9_.<>*]*)`", first)
+        if m:
+            (dynamic if "<" in m.group(1) else literal).add(m.group(1))
+    return literal, dynamic
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="Assert docs/observability.md lists exactly the "
-                    "metrics mxnet_tpu/ emits")
+        description="Assert the docs track exactly what the code "
+                    "emits: metric names, perf_gate budget flags, "
+                    "chaos injection sites")
     ap.add_argument("--list", action="store_true",
-                    help="print both name sets and exit 0")
+                    help="print every audited set and exit 0")
     args = ap.parse_args(argv)
     code = code_metrics()
     docs = doc_metrics()
+    flags_code = perf_gate_flags()
+    flags_docs = doc_perf_gate_flags()
+    sites_code = code_chaos_sites()
+    sites_docs, sites_dynamic = doc_chaos_sites()
     if args.list:
-        print("code (%d):" % len(code))
-        for n in sorted(code):
-            print("  " + n)
-        print("docs (%d):" % len(docs))
-        for n in sorted(docs):
-            print("  " + n)
+        for title, names in (("code metrics", code),
+                             ("doc metrics", docs),
+                             ("perf_gate flags", flags_code),
+                             ("doc flags", flags_docs),
+                             ("code chaos sites", sites_code),
+                             ("doc chaos sites",
+                              sites_docs | sites_dynamic)):
+            print("%s (%d):" % (title, len(names)))
+            for n in sorted(names):
+                print("  " + n)
         return 0
-    undocumented = sorted(code - docs)
-    stale = sorted(docs - code)
-    for n in undocumented:
-        print("DRIFT undocumented metric: %s (emitted in mxnet_tpu/, "
-              "missing from docs/observability.md)" % n,
-              file=sys.stderr)
-    for n in stale:
-        print("DRIFT stale doc row: %s (documented but no longer "
-              "emitted)" % n, file=sys.stderr)
-    if undocumented or stale:
+    drift = 0
+
+    def report(missing, fmt):
+        nonlocal drift
+        for n in sorted(missing):
+            drift += 1
+            print("DRIFT " + fmt % n, file=sys.stderr)
+
+    report(code - docs,
+           "undocumented metric: %s (emitted in mxnet_tpu/, missing "
+           "from docs/observability.md)")
+    report(docs - code,
+           "stale doc row: %s (documented but no longer emitted)")
+    report(flags_code - flags_docs,
+           "undocumented perf_gate flag: %s (registered in "
+           "tools/perf_gate.py, missing from docs/observability.md "
+           "\"Perf gate\")")
+    report(flags_docs - flags_code,
+           "stale perf_gate doc flag: %s (documented but not "
+           "registered)")
+    report(sites_code - sites_docs,
+           "undocumented chaos site: %s (wired in mxnet_tpu/, missing "
+           "from the docs/fault_tolerance.md site table)")
+    report(sites_docs - sites_code,
+           "stale chaos site row: %s (documented but no literal "
+           "chaos_point/corrupt_point wires it)")
+    if drift:
         return 1
-    print("docs_drift: %d metrics, docs and code agree" % len(code))
+    print("docs_drift: %d metrics, %d perf_gate flags, %d chaos sites "
+          "(+%d dynamic) — docs and code agree"
+          % (len(code), len(flags_code), len(sites_code),
+             len(sites_dynamic)))
     return 0
 
 

@@ -63,9 +63,15 @@ def _resnet():
 def batch_probe(batch, **kw):
     def run():
         import bench
+        from mxnet_tpu.observability import goodput
         r, _ = bench._train_tput(lambda: _resnet(), batch, 224, 50, 10,
                                  **kw)
-        return {"img_s": round(r, 2)}
+        # same denominator the StepTimer MFU uses: the shared goodput
+        # peak-FLOPs table (MXTPU_PEAK_FLOPS override respected), so
+        # probe MFU and telemetry MFU are directly comparable
+        return {"img_s": round(r, 2),
+                "mfu": round(min(1.0, r * 3 * 4.089e9
+                                 / goodput.peak_flops()), 4)}
     return run
 
 

@@ -56,6 +56,18 @@ is rejected):
                           ``--max-p99-ms-class interactive=50``) — the
                           front door's interactive-tail CI gate
                           (docs/serving.md "Front door & multiplexing")
+    --max-hbm-mb          ceiling on the HBM ledger's PEAK resident
+                          megabytes over the stream's source="memory"
+                          timeline records (docs/observability.md
+                          "Memory ledger") — a model-footprint
+                          regression fails CI before it OOMs a real
+                          chip. Absent metric (no memory records) is a
+                          breach
+    --min-mfu             floor on the p50 per-step model FLOPs
+                          utilization ([0, 1]; StepTimer derives it
+                          from goodput.flops deltas — docs/
+                          observability.md "Goodput & MFU"). A stream
+                          whose steps carry no mfu field is a breach
     --min-steps           refuse a stream shorter than this (default 1
                           — a truncated run must not "pass")
 
@@ -121,6 +133,13 @@ def evaluate(summary, args):
     check("dispatches_per_step", "dispatches_per_step",
           args.max_dispatches_per_step, le)
     check("cold_start_s", "cold_start_max_s", args.max_cold_start_s, le)
+    # HBM-ledger peak (docs/observability.md "Memory ledger"): the max
+    # ledger total across the stream's source="memory" timeline
+    # records, in MiB. Absent metric = breach, as always.
+    check("hbm_peak_mb", "hbm_peak_mb", args.max_hbm_mb, le)
+    # goodput floor: p50 of the per-step MFU StepTimer derives from
+    # goodput.flops deltas (docs/observability.md "Goodput & MFU")
+    check("mfu_p50", "mfu_p50", args.min_mfu, ge)
     check("gateway_success_rate", "gateway_success_rate",
           args.min_success_rate, ge)
     for cls, budget in (args.class_p99_budgets or {}).items():
@@ -155,6 +174,13 @@ def main(argv=None):
                          "training step (fused path = 1; absent "
                          "metric = breach)")
     ap.add_argument("--max-cold-start-s", type=float, default=None)
+    ap.add_argument("--max-hbm-mb", type=float, default=None,
+                    help="ceiling on the HBM ledger's peak resident "
+                         "MiB over source=\"memory\" records (absent "
+                         "metric = breach)")
+    ap.add_argument("--min-mfu", type=float, default=None,
+                    help="floor on p50 per-step MFU in [0, 1] (absent "
+                         "metric = breach)")
     ap.add_argument("--min-success-rate", type=float, default=None)
     ap.add_argument("--max-p99-ms-class", action="append", default=None,
                     metavar="CLASS=MS",
@@ -186,7 +212,7 @@ def main(argv=None):
                args.max_compiles, args.min_samples_per_sec,
                args.max_data_wait_frac, args.max_skipped_steps,
                args.max_anomalies, args.max_dispatches_per_step,
-               args.max_cold_start_s,
+               args.max_cold_start_s, args.max_hbm_mb, args.min_mfu,
                args.min_success_rate, args.class_p99_budgets or None)
     if all(b is None for b in budgets):
         verdict["error"] = "no budgets given — nothing to assert"

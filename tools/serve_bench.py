@@ -177,6 +177,15 @@ def _build_model(features, hidden, classes=16, seed=7, depth=3):
     return sym, args
 
 
+def _ledger_mb():
+    """HBM-ledger resident MiB at record time (0.0 with
+    MXTPU_MEMLEDGER=0) — every summary record carries the model
+    footprint the run left resident (docs/observability.md
+    "Memory ledger")."""
+    from mxnet_tpu.observability import memory as _memory
+    return round(_memory.total_bytes() / (1024.0 * 1024.0), 2)
+
+
 def _percentile_ms(latencies, q):
     if not latencies:
         return 0.0
@@ -953,24 +962,28 @@ def main(argv=None):
     if args_ns.mode == "coldstart":
         record = run_coldstart(args_ns)
         record["platform"] = jax.default_backend()
+        record["hbm_mb"] = _ledger_mb()
         print(json.dumps(record))
         return 0
 
     if args_ns.mode == "decode":
         record = run_decode(args_ns)
         record["platform"] = jax.default_backend()
+        record["hbm_mb"] = _ledger_mb()
         print(json.dumps(record))
         return 0
 
     if args_ns.mode == "gateway":
         record = run_gateway(args_ns)
         record["platform"] = jax.default_backend()
+        record["hbm_mb"] = _ledger_mb()
         print(json.dumps(record))
         return 0
 
     if args_ns.mode == "chaos":
         record = run_chaos(args_ns)
         record["platform"] = jax.default_backend()
+        record["hbm_mb"] = _ledger_mb()
         print(json.dumps(record))
         return 0
 
@@ -1044,6 +1057,7 @@ def main(argv=None):
                   else "serving_open_loop_throughput",
         "value": round(headline["rps"], 2), "unit": "req/s",
         "platform": jax.default_backend(),
+        "hbm_mb": _ledger_mb(),
         "extra": extra}))
     return 0
 

@@ -30,7 +30,7 @@ class ReportError(Exception):
 #: filters by its own exact source; a NEW excluded source is added
 #: here, once (it used to be re-spelled per section).
 EXCLUDED_HEADLINE_SOURCES = ("serving", "decode", "resilience",
-                             "compile", "gateway", "trace")
+                             "compile", "gateway", "trace", "memory")
 
 
 def headline_records(records):
@@ -402,6 +402,38 @@ def summarize(records):
     if tr:
         summary["trace_spans"] = len(tr)
         summary["trace_traces"] = len({r.get("trace_id") for r in tr})
+    # memory section (docs/observability.md "Memory ledger"):
+    # source="memory" records are HBM-ledger timeline events (update/
+    # release/oom) with the ledger total at event time — excluded from
+    # the headline, once, via EXCLUDED_HEADLINE_SOURCES. Resident is
+    # the LAST total seen (the stream is ordered), peak the max.
+    mem = [r for r in records if r.get("source") == "memory"]
+    if mem:
+        totals = [float(r["total_bytes"]) for r in mem
+                  if isinstance(r.get("total_bytes"), (int, float))]
+        if totals:
+            summary["hbm_resident_mb"] = totals[-1] / (1024.0 * 1024.0)
+            summary["hbm_peak_mb"] = max(totals) / (1024.0 * 1024.0)
+        summary["hbm_models"] = sorted(
+            {str(r.get("model", "?")) for r in mem
+             if r.get("model")})
+        oom = [r for r in mem if r.get("event") == "oom"]
+        summary["oom_events"] = len(oom)
+        if oom:
+            summary["oom_sites"] = sorted(
+                {str(r.get("site", "?")) for r in oom})
+    # goodput section (docs/observability.md "Goodput & MFU"): per-step
+    # MFU rides training records (StepTimer derives it from the
+    # step_flops counter delta); percentiles over steps that carried it
+    mfus = sorted(float(r["mfu"]) for r in core
+                  if isinstance(r.get("mfu"), (int, float)))
+    step_flops = sum(float(r.get("step_flops", 0)) for r in core)
+    if mfus:
+        summary["mfu_p50"] = _percentile(mfus, 0.50)
+        summary["mfu_p95"] = _percentile(mfus, 0.95)
+        summary["mfu_mean"] = sum(mfus) / len(mfus)
+    if step_flops:
+        summary["total_flops"] = step_flops
     # lease/watchdog section (docs/fault_tolerance.md): DeviceLease and
     # HealthWatchdog emit source="resilience" events — step_time is the
     # event's duration (acquire wait, takeover time, tripped budget)
@@ -607,6 +639,22 @@ def format_summary(s):
                 % (s["cold_starts"], s["cold_start_p50_s"],
                    s["cold_start_max_s"], s["cold_start_compile_s"],
                    s.get("aot_loads", 0), s.get("aot_fallbacks", 0)))
+    if "hbm_resident_mb" in s or s.get("oom_events"):
+        lines.append(
+            "  memory      HBM resident %.1f MiB  peak %.1f MiB  "
+            "(%d model(s))  %d OOM event(s)%s"
+            % (s.get("hbm_resident_mb", 0.0), s.get("hbm_peak_mb", 0.0),
+               len(s.get("hbm_models", [])), s.get("oom_events", 0),
+               ("  sites %s" % ", ".join(s["oom_sites"])
+                if s.get("oom_sites") else "")))
+    if "mfu_p50" in s:
+        lines.append(
+            "  goodput     MFU p50 %.2f%%  p95 %.2f%%  mean %.2f%%"
+            "%s"
+            % (100.0 * s["mfu_p50"], 100.0 * s["mfu_p95"],
+               100.0 * s["mfu_mean"],
+               ("  (%.3g FLOPs total)" % s["total_flops"]
+                if "total_flops" in s else "")))
     if "trace_spans" in s:
         lines.append("  traces      %d span(s) across %d trace(s) — "
                      "merge shards with tools/trace_report.py"
